@@ -1,0 +1,20 @@
+"""sudoku_solver_distributed_tpu — a TPU-native distributed sudoku-solving framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+``cristiano-nicolau/sudoku_solver_distributed`` (reference mounted at
+/root/reference): the same ``/solve`` / ``/stats`` / ``/network`` HTTP surface and
+7-type UDP JSON peer protocol (reference README.md:29-79), but the solving engine
+is a batched bitmask constraint-propagation + speculative-DFS kernel running on a
+TPU device mesh instead of the reference's per-cell greedy CPU task farm
+(reference node.py:76-80, node.py:427-475).
+
+Layout:
+  ops/       batched board encoding, validation, propagation, branching kernels
+  models/    trusted CPU oracle solver, puzzle generator, board specs
+  parallel/  device-mesh execution: data-parallel solve, sharded search frontier
+  net/       P2P wire protocol, membership, stats gossip, HTTP API, CLI
+  utils/     handicap rate limiter, board rendering, logging
+  api.py     the `Sudoku` host-facing class (reference sudoku.py:5-140 surface)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Host-side model components: trusted oracle solver, puzzle generator, corpora."""
+
+from .oracle import oracle_solve, oracle_is_valid_solution, count_solutions
+from .generator import generate_board, generate_batch
+
+__all__ = [
+    "oracle_solve",
+    "oracle_is_valid_solution",
+    "count_solutions",
+    "generate_board",
+    "generate_batch",
+]

@@ -1,0 +1,77 @@
+"""Puzzle generation — the test-input fabric (reference gen.py:6-66 equivalent).
+
+Same recipe as the reference generator: fill the n independent diagonal boxes
+with random permutations, complete the board with a real backtracker, then
+blank a requested number of distinct cells (reference gen.py:31-52). Extended
+beyond the reference with: arbitrary board sizes, seeded determinism, batch
+generation, and an optional unique-solution certificate (the reference can
+emit multi-solution puzzles, which makes golden testing flaky).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+import numpy as np
+
+from .oracle import Board, count_solutions, oracle_solve
+
+
+def generate_board(
+    empty_boxes: int = 0,
+    *,
+    size: int = 9,
+    rng: Optional[random.Random] = None,
+    unique: bool = False,
+) -> Board:
+    """Generate one puzzle with ``empty_boxes`` blanked cells.
+
+    With ``unique=True`` cells are only blanked while the puzzle keeps a
+    single solution (so ``empty_boxes`` becomes an upper bound).
+    """
+    rng = rng or random.Random()
+    box = int(round(size ** 0.5))
+    board = [[0] * size for _ in range(size)]
+
+    # Diagonal boxes are mutually independent: fill each with a permutation.
+    for n in range(0, size, box):
+        nums = list(range(1, size + 1))
+        rng.shuffle(nums)
+        for i in range(box):
+            for j in range(box):
+                board[n + i][n + j] = nums.pop()
+
+    solved = oracle_solve(board)
+    assert solved is not None, "diagonal seed must always be completable"
+    board = solved
+
+    filled = [(i, j) for i in range(size) for j in range(size)]
+    rng.shuffle(filled)
+    removed = 0
+    for i, j in filled:
+        if removed >= empty_boxes:
+            break
+        keep = board[i][j]
+        board[i][j] = 0
+        if unique and count_solutions(board, limit=2) != 1:
+            board[i][j] = keep
+            continue
+        removed += 1
+    return board
+
+
+def generate_batch(
+    batch: int,
+    empty_boxes: int,
+    *,
+    size: int = 9,
+    seed: int = 0,
+    unique: bool = False,
+) -> np.ndarray:
+    """(batch, size, size) int32 array of puzzles, deterministic in ``seed``."""
+    rng = random.Random(seed)
+    out = np.empty((batch, size, size), dtype=np.int32)
+    for k in range(batch):
+        out[k] = generate_board(empty_boxes, size=size, rng=rng, unique=unique)
+    return out

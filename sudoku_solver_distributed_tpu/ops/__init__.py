@@ -1,0 +1,43 @@
+"""Batched TPU kernels for sudoku boards: encoding, validation, propagation, search."""
+
+from .spec import BoardSpec, SPEC_9, SPEC_16, SPEC_25, spec_for_size
+from .encode import (
+    unit_value_counts,
+    used_masks,
+    candidates,
+    duplicate_flags,
+    contradiction_flags,
+    solved_flags,
+)
+from .validate import (
+    check_boards,
+    check_rows,
+    check_cols,
+    check_boxes,
+    is_valid_move,
+)
+from .propagate import propagate, propagate_step
+from .solver import solve_batch, SolveResult
+
+__all__ = [
+    "BoardSpec",
+    "SPEC_9",
+    "SPEC_16",
+    "SPEC_25",
+    "spec_for_size",
+    "unit_value_counts",
+    "used_masks",
+    "candidates",
+    "duplicate_flags",
+    "contradiction_flags",
+    "solved_flags",
+    "check_boards",
+    "check_rows",
+    "check_cols",
+    "check_boxes",
+    "is_valid_move",
+    "propagate",
+    "propagate_step",
+    "solve_batch",
+    "SolveResult",
+]

@@ -1,0 +1,131 @@
+"""Board analysis + constraint propagation: naked & hidden singles.
+
+``analyze`` is the single fused per-sweep kernel shared by the standalone
+propagator and the DFS solver (ops/solver.py): from a batch of grids it
+derives, in one pass over the unit histograms, the per-cell candidate masks,
+the forced-assignment mask (naked ∪ hidden singles), and the per-board
+contradiction / solved verdicts.
+
+This is the TPU-native replacement for the reference's greedy "first valid
+number" per-cell probe (``solve_sudoku_destributed``, reference
+node.py:76-80): one sweep deduces *every* forced cell of *every* board in the
+batch. The fixed point runs as a ``lax.while_loop`` — static shapes, no
+Python control flow under jit.
+
+  * naked single  — an empty cell whose candidate set has exactly one value;
+  * hidden single — a (unit, value) pair with exactly one admitting cell.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .spec import BoardSpec
+from .encode import _counts_to_mask, box_index, mask_to_value, unit_value_counts
+
+
+class Analysis(NamedTuple):
+    cand: jnp.ndarray           # (B, N, N) int32 candidate bitmask (0 if filled)
+    assign: jnp.ndarray         # (B, N, N) int32 single-bit forced-value mask
+    contradiction: jnp.ndarray  # (B,) bool — unsatisfiable as-is
+    solved: jnp.ndarray         # (B,) bool — strict: every unit a permutation
+
+
+def analyze(grid: jnp.ndarray, spec: BoardSpec) -> Analysis:
+    """Fused sweep analysis of a (B, N, N) batch.
+
+    Contradiction covers: a duplicated value in a unit, an empty cell with an
+    empty candidate set, and out-of-range cell values (anything outside
+    0..N — e.g. a bogus clue of 10 on a 9×9 board can never be part of a
+    solution and must kill the branch rather than be "solved around").
+
+    Solved is the *strict* criterion — every row/col/box a permutation of
+    1..N (reference sudoku.py:119-140) — not the reference's weak sum-only
+    fork (node.py:97-114) whose acceptance of a row of nine 5s is a defect.
+    """
+    n, N = spec.box, spec.size
+    B = grid.shape[0]
+
+    rows, cols, boxes = unit_value_counts(grid, spec)  # (B, N, V) each
+    dup = (
+        (rows > 1).any(axis=(1, 2))
+        | (cols > 1).any(axis=(1, 2))
+        | (boxes > 1).any(axis=(1, 2))
+    )
+    solved = (
+        (rows == 1).all(axis=(1, 2))
+        & (cols == 1).all(axis=(1, 2))
+        & (boxes == 1).all(axis=(1, 2))
+    )
+
+    shifts = jnp.arange(N, dtype=jnp.int32)
+    row_used = _counts_to_mask(rows, spec)
+    col_used = _counts_to_mask(cols, spec)
+    box_used = _counts_to_mask(boxes, spec)
+    bidx = box_index(spec)
+    used = row_used[:, :, None] | col_used[:, None, :] | box_used[:, bidx]
+    empty = grid == 0
+    cand = jnp.where(empty, ~used & jnp.int32(spec.full_mask), jnp.int32(0))
+
+    conehot = (jnp.right_shift(cand[..., None], shifts) & 1).astype(jnp.int32)
+    row_tot = conehot.sum(axis=2)  # (B, N, V): admitting cells per (row, value)
+    col_tot = conehot.sum(axis=1)
+    box_tot = conehot.reshape(B, n, n, n, n, N).sum(axis=(2, 4)).reshape(B, N, N)
+    hidden = conehot & (
+        (row_tot[:, :, None, :] == 1)
+        | (col_tot[:, None, :, :] == 1)
+        | (box_tot[:, bidx, :] == 1)
+    ).astype(jnp.int32)
+    hidden_mask = jnp.left_shift(hidden, shifts).sum(axis=-1)
+
+    naked = jax.lax.population_count(cand) == 1
+    assign = jnp.where(naked, cand, hidden_mask)
+    assign = assign & -assign  # one value per cell per sweep
+
+    dead = (empty & (cand == 0)).any(axis=(1, 2))
+    bad_value = ((grid < 0) | (grid > N)).any(axis=(1, 2))
+    return Analysis(cand, assign, dup | dead | bad_value, solved)
+
+
+def propagate_step(grid: jnp.ndarray, spec: BoardSpec):
+    """One parallel singles-assignment sweep.
+
+    Returns (new_grid, changed) with changed (B,) bool. Simultaneous
+    assignment of all singles can momentarily write conflicting values on an
+    unsatisfiable board (two hidden singles of the same value in one unit);
+    that is deliberate — the contradiction is caught by the next sweep's
+    ``analyze`` and the branch pruned, which is cheaper than serializing.
+    """
+    a = analyze(grid, spec)
+    new_grid = jnp.where(
+        (grid == 0) & (a.assign != 0), mask_to_value(a.assign), grid
+    )
+    changed = (new_grid != grid).any(axis=(1, 2))
+    return new_grid, changed
+
+
+def propagate(grid: jnp.ndarray, spec: BoardSpec, max_iters: int | None = None):
+    """Run singles propagation to fixed point across the batch.
+
+    Returns (grid, iters) where iters is the (scalar int32) number of sweeps
+    executed — the engine's unit of validation work, folded into the node's
+    ``validations`` stat (the accounting contract of reference node.py:82-95).
+    """
+    if max_iters is None:
+        max_iters = spec.cells + 1  # each sweep fills ≥1 cell of an active board
+
+    def cond(state):
+        _, changed, it = state
+        return changed.any() & (it < max_iters)
+
+    def body(state):
+        g, _, it = state
+        g, changed = propagate_step(g, spec)
+        return g, changed, it + 1
+
+    init = (grid, jnp.ones((grid.shape[0],), jnp.bool_), jnp.int32(0))
+    grid, _, iters = jax.lax.while_loop(cond, body, init)
+    return grid, iters

@@ -1,0 +1,197 @@
+"""Batched speculative-DFS sudoku solver — the framework's flagship kernel.
+
+Where the reference "solves" by farming single cells to peers and greedily
+taking the first non-conflicting value (reference node.py:76-80, 427-475,
+477-532 — a heuristic that needs a swap-repair loop and still returns
+incomplete boards, see SURVEY.md §3.2), this engine is a *complete* solver:
+constraint propagation (naked + hidden singles) interleaved with
+minimum-remaining-values branching and explicit-stack backtracking, for a
+whole batch of boards simultaneously.
+
+XLA constraints shape the design: recursion becomes an explicit fixed-capacity
+guess stack; data-dependent control flow becomes per-board status lanes
+(RUNNING / SOLVED / UNSAT / OVERFLOW) with masked updates; the outer loop is a
+single ``lax.while_loop`` whose body does one of {assign singles, branch,
+backtrack} per board per iteration — every board advances every iteration, so
+the batch runs lockstep on the VPU with no host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .spec import BoardSpec
+from .encode import mask_to_value
+from .propagate import analyze
+
+RUNNING = 0
+SOLVED = 1
+UNSAT = 2
+OVERFLOW = 3  # guess stack exhausted (statically sized; see BoardSpec.max_depth)
+
+
+class SolveResult(NamedTuple):
+    grid: jnp.ndarray        # (B, N, N) int32 — solution where solved
+    solved: jnp.ndarray      # (B,) bool
+    status: jnp.ndarray      # (B,) int32 — SOLVED / UNSAT / OVERFLOW / RUNNING
+    guesses: jnp.ndarray     # (B,) int32 — speculative branches taken
+    validations: jnp.ndarray  # (B,) int32 — analysis sweeps while active
+    iters: jnp.ndarray       # () int32 — lockstep iterations executed
+
+
+class _State(NamedTuple):
+    grid: jnp.ndarray        # (B, C) int32, flattened boards
+    stack_grid: jnp.ndarray  # (B, D, C) int8 — snapshot at each guess
+    stack_cell: jnp.ndarray  # (B, D) int32 — flat cell index guessed at
+    stack_mask: jnp.ndarray  # (B, D) int32 — candidate bits not yet tried
+    depth: jnp.ndarray       # (B,) int32
+    status: jnp.ndarray      # (B,) int32
+    guesses: jnp.ndarray     # (B,) int32
+    validations: jnp.ndarray  # (B,) int32
+    iters: jnp.ndarray       # () int32
+
+
+def _step(state: _State, spec: BoardSpec) -> _State:
+    B, C = state.grid.shape
+    D = state.stack_mask.shape[1]
+    N = spec.size
+    b = jnp.arange(B)
+
+    # One fused sweep analysis shared with the standalone propagator
+    # (ops/propagate.py): candidates, forced singles, contradiction, solved.
+    a = analyze(state.grid.reshape(B, N, N), spec)
+    cand = a.cand.reshape(B, C)
+    assign = a.assign.reshape(B, C)
+    contra, solved = a.contradiction, a.solved
+    running = state.status == RUNNING
+
+    new_status = jnp.where(
+        running & solved, SOLVED, state.status
+    )
+    act = running & ~solved  # boards that still need work this iteration
+
+    # --- path 1: assign all singles (boards with ≥1 forced cell, no contradiction)
+    has_single = (assign != 0).any(axis=1)
+    do_assign = act & ~contra & has_single
+    assigned_grid = jnp.where(assign != 0, mask_to_value(assign), state.grid)
+
+    # --- path 2: branch (no contradiction, no singles) — MRV cell
+    do_branch = act & ~contra & ~has_single
+    pc = jax.lax.population_count(cand)
+    pc_key = jnp.where(state.grid == 0, pc, jnp.int32(jnp.iinfo(jnp.int32).max))
+    mrv_cell = jnp.argmin(pc_key, axis=1).astype(jnp.int32)  # (B,)
+    mrv_mask = cand[b, mrv_cell]
+    guess_bit = mrv_mask & -mrv_mask
+    overflow = do_branch & (state.depth >= D)
+    do_branch = do_branch & (state.depth < D)
+    new_status = jnp.where(overflow, OVERFLOW, new_status)
+
+    push_slot = jnp.clip(state.depth, 0, D - 1)
+    branched_grid = state.grid.at[b, mrv_cell].set(mask_to_value(guess_bit))
+
+    # --- path 3: backtrack (contradiction)
+    do_bt = act & contra
+    top = jnp.clip(state.depth - 1, 0, D - 1)
+    top_mask = state.stack_mask[b, top]
+    top_cell = state.stack_cell[b, top]
+    top_grid = state.stack_grid[b, top].astype(jnp.int32)  # (B, C)
+    empty_stack = state.depth == 0
+    exhausted = top_mask == 0
+    # pop-only: top guess has no remaining candidates → drop the frame, the
+    # grid stays contradictory and the next iteration pops again.
+    bt_pop = do_bt & ~empty_stack & exhausted
+    # retry: restore snapshot, take next untried bit at the same cell.
+    bt_retry = do_bt & ~empty_stack & ~exhausted
+    retry_bit = top_mask & -top_mask
+    retry_grid = top_grid.at[b, top_cell].set(mask_to_value(retry_bit))
+    new_status = jnp.where(do_bt & empty_stack, UNSAT, new_status)
+
+    # --- merge paths
+    grid = state.grid
+    grid = jnp.where(do_assign[:, None], assigned_grid, grid)
+    grid = jnp.where(do_branch[:, None], branched_grid, grid)
+    grid = jnp.where(bt_retry[:, None], retry_grid, grid)
+
+    stack_grid = state.stack_grid.at[b, push_slot].set(
+        jnp.where(
+            do_branch[:, None],
+            state.grid.astype(jnp.int8),
+            state.stack_grid[b, push_slot],
+        )
+    )
+    stack_cell = state.stack_cell.at[b, push_slot].set(
+        jnp.where(do_branch, mrv_cell, state.stack_cell[b, push_slot])
+    )
+    pushed_mask = mrv_mask & ~guess_bit
+    stack_mask = state.stack_mask.at[b, push_slot].set(
+        jnp.where(do_branch, pushed_mask, state.stack_mask[b, push_slot])
+    )
+    stack_mask = stack_mask.at[b, top].set(
+        jnp.where(bt_retry, top_mask & ~retry_bit, stack_mask[b, top])
+    )
+
+    depth = state.depth + do_branch.astype(jnp.int32) - bt_pop.astype(jnp.int32)
+
+    return _State(
+        grid=grid,
+        stack_grid=stack_grid,
+        stack_cell=stack_cell,
+        stack_mask=stack_mask,
+        depth=depth,
+        status=new_status,
+        guesses=state.guesses + do_branch.astype(jnp.int32),
+        validations=state.validations + running.astype(jnp.int32),
+        iters=state.iters + 1,
+    )
+
+
+def solve_batch(
+    grid: jnp.ndarray,
+    spec: BoardSpec,
+    *,
+    max_iters: int = 4096,
+    max_depth: int | None = None,
+) -> SolveResult:
+    """Solve a batch of boards to completion (or proven unsatisfiability).
+
+    Args:
+      grid: (B, N, N) integer boards, 0 = empty.
+      max_iters: lockstep iteration cap (safety net; typical 9×9 batches
+        finish in well under 100 iterations).
+      max_depth: guess-stack capacity override (default spec.max_depth).
+
+    Jit-safe and vmap/shard_map-friendly (static shapes throughout).
+    """
+    B = grid.shape[0]
+    C = spec.cells
+    D = max_depth if max_depth is not None else spec.max_depth
+
+    state = _State(
+        grid=grid.astype(jnp.int32).reshape(B, C),
+        stack_grid=jnp.zeros((B, D, C), jnp.int8),
+        stack_cell=jnp.zeros((B, D), jnp.int32),
+        stack_mask=jnp.zeros((B, D), jnp.int32),
+        depth=jnp.zeros((B,), jnp.int32),
+        status=jnp.zeros((B,), jnp.int32),
+        guesses=jnp.zeros((B,), jnp.int32),
+        validations=jnp.zeros((B,), jnp.int32),
+        iters=jnp.int32(0),
+    )
+
+    def cond(s: _State):
+        return (s.status == RUNNING).any() & (s.iters < max_iters)
+
+    state = jax.lax.while_loop(cond, lambda s: _step(s, spec), state)
+
+    N = spec.size
+    return SolveResult(
+        grid=state.grid.reshape(B, N, N),
+        solved=state.status == SOLVED,
+        status=state.status,
+        guesses=state.guesses,
+        validations=state.validations,
+        iters=state.iters,
+    )

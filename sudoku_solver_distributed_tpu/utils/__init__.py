@@ -1,0 +1,6 @@
+"""Host-side utilities: handicap rate limiting, board rendering, logging."""
+
+from .ratelimit import HandicapLimiter
+from .render import render_board, render_board_highlight_zeros
+
+__all__ = ["HandicapLimiter", "render_board", "render_board_highlight_zeros"]

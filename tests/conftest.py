@@ -1,0 +1,51 @@
+"""Test harness configuration.
+
+Runs the whole suite on a virtual 8-device CPU mesh — the "fake backend" for
+distributed tests (the reference's analog is N OS processes on localhost,
+SURVEY.md §4.3): kernels compile fast, sharding/collective paths are exercised
+without TPU hardware, and multi-chip layouts are validated exactly as the
+driver's ``dryrun_multichip`` does.
+
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_sudoku_tpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+# The reference README's 8-clue example puzzle (reference README.md:20) — the
+# canonical hard input; the reference solves it in 168.4 s (BASELINE.md).
+README_PUZZLE = [
+    [0, 0, 0, 1, 0, 0, 0, 0, 0],
+    [0, 0, 0, 3, 2, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 9, 0, 0, 0],
+    [0, 0, 0, 0, 0, 0, 0, 7, 0],
+    [0, 0, 0, 0, 0, 0, 0, 0, 0],
+    [0, 0, 0, 9, 0, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 0, 9, 0, 0],
+    [0, 0, 0, 0, 0, 0, 0, 0, 3],
+    [0, 0, 0, 0, 0, 0, 0, 0, 0],
+]
+
+
+@pytest.fixture
+def readme_puzzle():
+    return [row[:] for row in README_PUZZLE]

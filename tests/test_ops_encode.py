@@ -1,0 +1,110 @@
+"""Unit tests for the bitmask encoding kernels (ops/encode.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.models import generate_batch, oracle_solve
+from sudoku_solver_distributed_tpu.ops import (
+    SPEC_9,
+    candidates,
+    contradiction_flags,
+    duplicate_flags,
+    solved_flags,
+    spec_for_size,
+    unit_value_counts,
+)
+from sudoku_solver_distributed_tpu.ops.encode import (
+    box_index,
+    cell_used_mask,
+    mask_to_value,
+    value_bitmask,
+)
+
+
+def test_box_index_layout():
+    bidx = np.asarray(box_index(SPEC_9))
+    assert bidx[0, 0] == 0 and bidx[0, 8] == 2
+    assert bidx[4, 4] == 4 and bidx[8, 8] == 8
+    # each box id covers exactly 9 cells
+    assert all((bidx == k).sum() == 9 for k in range(9))
+
+
+def test_value_bitmask_roundtrip():
+    g = jnp.array([[[0, 1, 9], [5, 3, 2], [0, 0, 4]]], dtype=jnp.int32)
+    m = value_bitmask(g)
+    assert np.array_equal(np.asarray(mask_to_value(m)), np.asarray(g))
+
+
+def test_unit_counts_against_numpy(rng):
+    boards = rng.integers(0, 10, size=(16, 9, 9)).astype(np.int32)
+    rows, cols, boxes = (np.asarray(x) for x in unit_value_counts(jnp.asarray(boards), SPEC_9))
+    for b in range(16):
+        for u in range(9):
+            for v in range(9):
+                assert rows[b, u, v] == np.sum(boards[b, u] == v + 1)
+                assert cols[b, u, v] == np.sum(boards[b, :, u] == v + 1)
+                bi, bj = (u // 3) * 3, (u % 3) * 3
+                assert boxes[b, u, v] == np.sum(
+                    boards[b, bi : bi + 3, bj : bj + 3] == v + 1
+                )
+
+
+def test_candidates_match_bruteforce(rng):
+    boards = generate_batch(8, 40, seed=7)
+    cand = np.asarray(candidates(jnp.asarray(boards), SPEC_9))
+    for b in range(8):
+        for i in range(9):
+            for j in range(9):
+                if boards[b, i, j] != 0:
+                    assert cand[b, i, j] == 0
+                    continue
+                bi, bj = (i // 3) * 3, (j // 3) * 3
+                peers = set(boards[b, i, :]) | set(boards[b, :, j]) | set(
+                    boards[b, bi : bi + 3, bj : bj + 3].ravel()
+                )
+                want = sum(
+                    1 << (v - 1) for v in range(1, 10) if v not in peers
+                )
+                assert cand[b, i, j] == want
+
+
+def test_flags_on_known_boards(readme_puzzle):
+    solved = oracle_solve(readme_puzzle)
+    dup = [row[:] for row in solved]
+    dup[0][0] = dup[0][1]  # introduce a duplicate
+    boards = jnp.asarray(np.stack([readme_puzzle, solved, dup]), dtype=jnp.int32)
+    assert np.asarray(duplicate_flags(boards, SPEC_9)).tolist() == [False, False, True]
+    assert np.asarray(solved_flags(boards, SPEC_9)).tolist() == [False, True, False]
+    assert np.asarray(contradiction_flags(boards, SPEC_9)).tolist()[1] is False
+
+
+def test_dead_cell_contradiction():
+    # cell (0,0) empty but its row+col+box cover all 9 values → contradiction
+    board = np.zeros((1, 9, 9), np.int32)
+    board[0, 0, 1:9] = [1, 2, 3, 4, 5, 6, 7, 8]
+    board[0, 1, 0] = 9
+    assert not np.asarray(duplicate_flags(jnp.asarray(board), SPEC_9))[0]
+    assert np.asarray(contradiction_flags(jnp.asarray(board), SPEC_9))[0]
+
+
+@pytest.mark.parametrize("size", [16, 25])
+def test_bigger_boards_candidates(size):
+    spec = spec_for_size(size)
+    board = np.zeros((1, size, size), np.int32)
+    board[0, 0, 0] = 1
+    cand = np.asarray(candidates(jnp.asarray(board), spec))
+    assert cand[0, 0, 0] == 0
+    # peer of the clue: bit 0 cleared
+    assert cand[0, 0, 1] == spec.full_mask & ~1
+    # non-peer: everything open
+    assert cand[0, size - 1, size - 1] == spec.full_mask
+
+
+def test_cell_used_mask_matches_candidates(rng):
+    boards = jnp.asarray(rng.integers(0, 10, size=(4, 9, 9)).astype(np.int32))
+    used = np.asarray(cell_used_mask(boards, SPEC_9))
+    cand = np.asarray(candidates(boards, SPEC_9))
+    empty = np.asarray(boards) == 0
+    assert ((used & cand) == 0).all()
+    assert ((cand | used)[empty] == SPEC_9.full_mask).all()

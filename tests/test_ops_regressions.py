@@ -1,0 +1,49 @@
+"""Regression tests for defects found in code review."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.models import oracle_solve
+from sudoku_solver_distributed_tpu.ops import (
+    SPEC_9,
+    contradiction_flags,
+    solve_batch,
+    solved_flags,
+    spec_for_size,
+)
+from sudoku_solver_distributed_tpu.ops.solver import UNSAT
+from sudoku_solver_distributed_tpu.ops.spec import BoardSpec
+
+
+def test_out_of_range_value_is_not_solved(readme_puzzle):
+    solved = np.asarray(oracle_solve(readme_puzzle), np.int32)
+    bad = solved.copy()
+    bad[0, 0] = 10
+    batch = jnp.asarray(np.stack([solved, bad]))
+    assert np.asarray(solved_flags(batch, SPEC_9)).tolist() == [True, False]
+    assert np.asarray(contradiction_flags(batch, SPEC_9)).tolist() == [False, True]
+
+
+def test_bogus_clue_makes_board_unsat():
+    board = np.zeros((1, 9, 9), np.int32)
+    board[0, 0, 0] = 10
+    res = jax.jit(lambda g: solve_batch(g, SPEC_9))(jnp.asarray(board))
+    assert not bool(res.solved[0])
+    assert int(res.status[0]) == UNSAT
+
+
+def test_negative_value_is_contradiction():
+    board = np.zeros((1, 9, 9), np.int32)
+    board[0, 4, 4] = -3
+    assert bool(np.asarray(contradiction_flags(jnp.asarray(board), SPEC_9))[0])
+
+
+def test_oversized_board_rejected():
+    with pytest.raises(ValueError):
+        spec_for_size(36)
+    with pytest.raises(ValueError):
+        BoardSpec(box=6)
+    with pytest.raises(ValueError):
+        BoardSpec(box=1)

@@ -1,0 +1,67 @@
+"""Tests for the batched validation kernels vs brute-force / oracle checks."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.models import generate_batch, oracle_solve
+from sudoku_solver_distributed_tpu.ops import (
+    SPEC_9,
+    check_boards,
+    check_boxes,
+    check_cols,
+    check_rows,
+    is_valid_move,
+)
+
+
+def test_check_boards_strict(readme_puzzle):
+    solved = np.asarray(oracle_solve(readme_puzzle), np.int32)
+    weak = np.full((9, 9), 5, np.int32)  # rows sum to 45 but are not permutations
+    bad = solved.copy()
+    bad[3, 3] = bad[3, 4]
+    batch = jnp.asarray(np.stack([solved, weak, bad, np.asarray(readme_puzzle)]))
+    got = np.asarray(check_boards(batch, SPEC_9)).tolist()
+    # the reference's weak checker (node.py:97-114) would pass `weak`; the
+    # strict contract (sudoku.py:119-140) must reject it.
+    assert got == [True, False, False, False]
+
+
+def test_unit_checks(readme_puzzle):
+    solved = np.asarray(oracle_solve(readme_puzzle), np.int32)
+    batch = jnp.asarray(solved[None])
+    assert np.asarray(check_rows(batch, SPEC_9)).all()
+    assert np.asarray(check_cols(batch, SPEC_9)).all()
+    assert np.asarray(check_boxes(batch, SPEC_9)).all()
+    partial = solved.copy()
+    partial[2, 5] = 0
+    batch = jnp.asarray(partial[None])
+    rows = np.asarray(check_rows(batch, SPEC_9))[0]
+    assert not rows[2] and rows[[0, 1, 3, 4, 5, 6, 7, 8]].all()
+
+
+def test_is_valid_move_matches_scan(rng):
+    boards = generate_batch(4, 35, seed=9)
+    jb = jnp.asarray(boards)
+    for _ in range(50):
+        b = int(rng.integers(4))
+        i, j = int(rng.integers(9)), int(rng.integers(9))
+        num = int(rng.integers(1, 10))
+        got = bool(np.asarray(is_valid_move(jb[b : b + 1], i, j, num, SPEC_9))[0])
+        # reference semantics (sudoku.py:60-78): num may not appear anywhere
+        # in row i, col j, or the box of (i, j) — the cell itself included.
+        bi, bj = (i // 3) * 3, (j // 3) * 3
+        peers = (
+            set(boards[b, i, :])
+            | set(boards[b, :, j])
+            | set(boards[b, bi : bi + 3, bj : bj + 3].ravel())
+        )
+        assert got == (num not in peers)
+
+
+def test_is_valid_move_batched_args():
+    boards = jnp.asarray(generate_batch(8, 20, seed=1))
+    rows = jnp.arange(8) % 9
+    cols = (jnp.arange(8) * 3) % 9
+    nums = jnp.arange(8) % 9 + 1
+    out = np.asarray(is_valid_move(boards, rows, cols, nums, SPEC_9))
+    assert out.shape == (8,)
