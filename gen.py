@@ -1,0 +1,43 @@
+"""Root shim: the reference's puzzle-generator CLI (reference gen.py:1-66).
+
+Same contract: ``python3 gen.py N`` generates a puzzle with N blanked cells,
+prints the board (zeros highlighted), then prints a ready-made curl command to
+feed it to a node (reference gen.py:61-66). Generation itself is the package
+generator (diagonal-box seed + backtracking completion + blanking — the
+reference's own recipe, reference gen.py:31-52).
+"""
+
+import random
+import sys
+
+from sudoku_solver_distributed_tpu.api import Sudoku
+from sudoku_solver_distributed_tpu.models import generate_board, oracle_solve
+
+
+def solve_sudoku(board):
+    """Solve in place with the host backtracker - this is NOT a distributed
+    solution (reference gen.py:6-28 contract)."""
+    solved = oracle_solve(board)
+    if solved is None:
+        return False
+    for i, row in enumerate(solved):
+        board[i][:] = row
+    return True
+
+
+def generate_sudoku(empty_boxes=0):
+    """Generate a Sudoku puzzle (reference gen.py:31-52 contract)."""
+    return Sudoku(generate_board(empty_boxes, rng=random.Random()))
+
+
+if __name__ == "__main__":
+    empty_boxes = int(sys.argv[1])
+
+    new_puzzle = generate_sudoku(empty_boxes)
+
+    print(new_puzzle)
+
+    print(
+        "curl http://localhost:8001/solve -X POST -H 'Content-Type: application/json' -d '{\"sudoku\": %s}'"
+        % (new_puzzle.grid)
+    )

@@ -1,0 +1,12 @@
+"""Root shim: the reference's node CLI (reference node.py:715-730).
+
+``python3 node.py -p 8001 -s 7001 -a localhost:7000 -h 1`` launches one P2P
+node exactly as against the reference repo — same flags, same UDP protocol,
+same HTTP surface — with the TPU engine behind it. See
+sudoku_solver_distributed_tpu/net/cli.py for the extension flags.
+"""
+
+from sudoku_solver_distributed_tpu.net.cli import main
+
+if __name__ == "__main__":
+    main()

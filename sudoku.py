@@ -1,0 +1,34 @@
+"""Root shim: the reference's ``sudoku.py`` surface (reference sudoku.py:1-163).
+
+``from sudoku import Sudoku`` works exactly as against the reference repo; the
+class itself lives in sudoku_solver_distributed_tpu.api and validates through
+the batched TPU kernels. The __main__ smoke block mirrors the reference's
+(reference sudoku.py:143-163): validate a known-correct board and report.
+"""
+
+from sudoku_solver_distributed_tpu.api import Sudoku
+
+__all__ = ["Sudoku"]
+
+
+if __name__ == "__main__":
+    sudoku = Sudoku(
+        [
+            [8, 9, 7, 1, 2, 4, 6, 3, 5],
+            [5, 3, 1, 6, 7, 9, 2, 8, 4],
+            [6, 4, 2, 3, 8, 5, 1, 7, 9],
+            [1, 5, 4, 2, 9, 3, 8, 6, 7],
+            [2, 8, 9, 7, 1, 6, 4, 5, 3],
+            [3, 7, 6, 4, 5, 8, 9, 1, 2],
+            [9, 2, 3, 8, 6, 7, 5, 4, 1],
+            [7, 6, 5, 9, 4, 1, 3, 2, 8],
+            [4, 1, 8, 5, 3, 2, 7, 9, 6],
+        ]
+    )
+
+    print(sudoku)
+
+    if sudoku.check():
+        print("Sudoku is correct!")
+    else:
+        print("Sudoku is incorrect! Please check your solution.")

@@ -1,0 +1,147 @@
+"""Host-facing ``Sudoku`` class — the reference's public board API.
+
+Surface-compatible with reference sudoku.py:5-140: same constructor signature,
+``grid`` attribute, ANSI ``__str__``, ``update_row`` / ``update_column``
+helpers, and the rate-limited ``check_is_valid`` / ``check_row`` /
+``check_column`` / ``check_square`` / ``check`` validation methods (including
+the per-call ``base_delay`` / ``interval`` / ``threshold`` overrides).
+
+The implementation is TPU-native: every check dispatches to the batched
+bitmask kernels (ops/validate.py) through cached jitted entry points, so the
+same code path validates one hosted board here and a million-board batch in
+the engine. The handicap rate limiter (reference sudoku.py:13-30) gates these
+host-facing calls only — it is the course's simulated compute cost, not a
+property of the device kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import spec_for_size
+from .ops.validate import (
+    check_boards,
+    check_boxes,
+    check_cols,
+    check_rows,
+    is_valid_move,
+)
+from .utils import HandicapLimiter, render_board_highlight_zeros
+
+
+@functools.lru_cache(maxsize=None)
+def _kernels(size: int):
+    """Jitted single-board validation kernels for a given board size."""
+    spec = spec_for_size(size)
+    return {
+        "board": jax.jit(lambda g: check_boards(g, spec)),
+        "rows": jax.jit(lambda g: check_rows(g, spec)),
+        "cols": jax.jit(lambda g: check_cols(g, spec)),
+        "boxes": jax.jit(lambda g: check_boxes(g, spec)),
+        "move": jax.jit(
+            lambda g, r, c, v: is_valid_move(g, r, c, v, spec)
+        ),
+    }
+
+
+class Sudoku:
+    """A hosted board with rate-limited validation (reference sudoku.py:5-140)."""
+
+    def __init__(
+        self,
+        sudoku: Sequence[Sequence[int]],
+        base_delay: float = 0.01,
+        interval: float = 10,
+        threshold: int = 5,
+    ):
+        self.grid: List[List[int]] = [list(r) for r in sudoku]
+        self.base_delay = base_delay
+        self.interval = interval
+        self.threshold = threshold
+        self._limiter = HandicapLimiter(base_delay, interval, threshold)
+        self._size = len(self.grid)
+        self._spec = spec_for_size(self._size)
+        # number of rate-limited validation calls made through this object —
+        # the accounting unit of reference node.py:87
+        self.validations = 0
+
+    # -- rendering ---------------------------------------------------------
+    def __str__(self) -> str:
+        return render_board_highlight_zeros(self.grid)
+
+    # -- mutation helpers (reference sudoku.py:51-58) ----------------------
+    def update_row(self, row: int, values: Sequence[int]) -> None:
+        self.grid[row] = list(values)
+
+    def update_column(self, col: int, values: Sequence[int]) -> None:
+        for row in range(self._size):
+            self.grid[row][col] = values[row]
+
+    # -- validation surface ------------------------------------------------
+    def _tick(self, base_delay, interval, threshold) -> None:
+        self.validations += 1
+        self._limiter.tick(base_delay, interval, threshold)
+
+    def _device_grid(self) -> jnp.ndarray:
+        return jnp.asarray(np.asarray(self.grid, np.int32)[None])
+
+    def check_is_valid(
+        self, row: int, col: int, num: int,
+        base_delay=None, interval=None, threshold=None,
+    ) -> bool:
+        """True iff ``num`` appears nowhere in the row/col/box of (row, col)
+        (the queried cell included — reference sudoku.py:60-78 semantics)."""
+        self._tick(base_delay, interval, threshold)
+        out = _kernels(self._size)["move"](
+            self._device_grid(),
+            jnp.int32(row), jnp.int32(col), jnp.int32(num),
+        )
+        return bool(out[0])
+
+    def check_row(self, row: int, base_delay=None, interval=None, threshold=None) -> bool:
+        self._tick(base_delay, interval, threshold)
+        return bool(_kernels(self._size)["rows"](self._device_grid())[0, row])
+
+    def check_column(self, col: int, base_delay=None, interval=None, threshold=None) -> bool:
+        self._tick(base_delay, interval, threshold)
+        return bool(_kernels(self._size)["cols"](self._device_grid())[0, col])
+
+    def check_square(self, row: int, col: int, base_delay=None, interval=None, threshold=None) -> bool:
+        """Check the box whose top-left corner is (row, col) — the reference
+        calls this with (i*3, j*3) (reference sudoku.py:103-117, 135-137)."""
+        self._tick(base_delay, interval, threshold)
+        box = self._spec.box
+        box_id = (row // box) * box + (col // box)
+        return bool(_kernels(self._size)["boxes"](self._device_grid())[0, box_id])
+
+    def check(self, base_delay=None, interval=None, threshold=None) -> bool:
+        """Strict whole-board check (reference sudoku.py:119-140).
+
+        The reference issues one rate-limited call per unit (9+9+9 for 9×9,
+        short-circuiting on the first failure); we preserve that accounting by
+        ticking the limiter per unit while validating all units in one fused
+        device call.
+        """
+        k = _kernels(self._size)
+        g = self._device_grid()
+        rows = np.asarray(k["rows"](g)[0])
+        cols = np.asarray(k["cols"](g)[0])
+        boxes = np.asarray(k["boxes"](g)[0])
+        for ok in rows:
+            self._tick(base_delay, interval, threshold)
+            if not ok:
+                return False
+        for ok in cols:
+            self._tick(base_delay, interval, threshold)
+            if not ok:
+                return False
+        for ok in boxes:
+            self._tick(base_delay, interval, threshold)
+            if not ok:
+                return False
+        return True

@@ -1,0 +1,154 @@
+"""Serving engine: warmed, bucketed, optionally mesh-sharded batch solving.
+
+The reference's solving entry point is one HTTP thread calling a Python loop
+per cell (reference node.py:534-557). Here the entry point is a *pre-compiled*
+device program: request boards are padded into a small set of static batch
+buckets (so no request ever pays a trace/compile), solved in one device call,
+and the per-board validation-sweep counts are folded into host-side stats.
+
+p50-latency contract (BASELINE.md north star <5 ms): ``warmup()`` compiles
+every bucket ahead of serving, so a single-puzzle ``/solve`` is one
+donated-buffer device call on a hot program.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import BoardSpec, SPEC_9, solve_batch
+
+
+DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
+
+
+class SolverEngine:
+    """Batched sudoku solving behind static-shape compiled programs.
+
+    Args:
+      spec: board geometry (default classic 9×9).
+      buckets: ascending static batch sizes; a request of B boards runs in
+        the smallest bucket ≥ B (or tiles over the largest).
+      max_depth: guess-stack capacity override passed to the kernel (None →
+        the safe per-spec default; benchmarks use a smaller stack).
+      sharding: optional jax.sharding.Sharding for the batch axis — supply a
+        NamedSharding over a device mesh to fan one bucket out across chips
+        (the TPU-native analog of the reference's peer task farm).
+    """
+
+    def __init__(
+        self,
+        spec: BoardSpec = SPEC_9,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_depth: Optional[int] = None,
+        sharding: Optional[jax.sharding.Sharding] = None,
+    ):
+        self.spec = spec
+        self.buckets = tuple(sorted(set(buckets)))
+        self.max_depth = max_depth
+        self.sharding = sharding
+        self._lock = threading.Lock()
+        # cumulative engine effort, the analog of the reference's
+        # `validations` counter (node.py:87): one unit per analysis sweep per
+        # active board.
+        self.validations = 0
+        self.solved_puzzles = 0
+
+        def _run(grid):
+            res = solve_batch(grid, self.spec, max_depth=self.max_depth)
+            B = grid.shape[0]
+            # Pack every result field into ONE int32 array: the serving path
+            # pays exactly one device→host transfer per request. (Unpacked,
+            # each field is its own transfer — at ~70 ms RTT over a tunneled
+            # TPU that quadruples request latency.)
+            return jnp.concatenate(
+                [
+                    res.grid.reshape(B, -1),
+                    res.solved[:, None].astype(jnp.int32),
+                    res.status[:, None],
+                    res.guesses[:, None],
+                    res.validations[:, None],
+                ],
+                axis=1,
+            )
+
+        self._solve = jax.jit(_run, donate_argnums=0)
+
+    # -- internals ---------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _device_batch(self, boards: np.ndarray) -> jnp.ndarray:
+        arr = jnp.asarray(boards)
+        if self.sharding is not None:
+            arr = jax.device_put(arr, self.sharding)
+        return arr
+
+    def _solve_padded(self, boards: np.ndarray) -> np.ndarray:
+        """Solve ≤bucket boards, padding with empty boards (always solvable).
+
+        Returns the packed (n, C+4) host array: [grid | solved | status |
+        guesses | validations] per row.
+        """
+        n = boards.shape[0]
+        bucket = self._bucket_for(n)
+        if n < bucket:
+            pad = np.zeros((bucket - n, *boards.shape[1:]), boards.dtype)
+            boards = np.concatenate([boards, pad], axis=0)
+        packed = self._solve(self._device_batch(boards))
+        return np.asarray(packed)[:n]
+
+    # -- public API --------------------------------------------------------
+    def warmup(self) -> None:
+        """Pre-compile every bucket (first TPU compile is ~seconds; serving
+        must never pay it — reference node.py has the same issue in spirit:
+        its first request is as slow as every other)."""
+        N = self.spec.size
+        for b in self.buckets:
+            jax.block_until_ready(
+                self._solve(self._device_batch(np.zeros((b, N, N), np.int32)))
+            )
+
+    def solve_batch_np(self, boards: np.ndarray) -> Tuple[np.ndarray, np.ndarray, dict]:
+        """Solve (B, N, N) boards.
+
+        Returns (solutions, solved_mask, info). Solutions rows for unsolved
+        boards hold the partial/original grid. Tiles over the largest bucket
+        for oversize batches.
+        """
+        boards = np.asarray(boards, np.int32)
+        B = boards.shape[0]
+        N = self.spec.size
+        C = self.spec.cells
+        cap = self.buckets[-1]
+        packed_rows = []
+        for lo in range(0, B, cap):
+            packed_rows.append(self._solve_padded(boards[lo : lo + cap]))
+        packed = np.concatenate(packed_rows, axis=0)
+        solutions = packed[:, :C].reshape(B, N, N)
+        solved_mask = packed[:, C].astype(bool)
+        validations = int(packed[:, C + 3].sum())
+        guesses = int(packed[:, C + 2].sum())
+        with self._lock:
+            self.validations += validations
+            self.solved_puzzles += int(solved_mask.sum())
+        return solutions, solved_mask, {
+            "validations": validations,
+            "guesses": guesses,
+        }
+
+    def solve_one(self, board: Sequence[Sequence[int]]) -> Tuple[Optional[List[List[int]]], dict]:
+        """Solve a single board; returns (solution | None, info)."""
+        arr = np.asarray(board, np.int32)[None]
+        solutions, solved_mask, info = self.solve_batch_np(arr)
+        if not solved_mask[0]:
+            return None, info
+        return solutions[0].tolist(), info

@@ -1,0 +1,29 @@
+"""P2P service layer: wire protocol, membership, stats gossip, node, HTTP API.
+
+The host-side control plane of the framework. The wire surface — 7 UDP JSON
+message types (reference README.md:69-79) and 3 HTTP routes (reference
+node.py:666-704) — is byte-identical to the reference; the compute behind it
+is the TPU engine (engine.py / parallel/). Known reference defects are fixed
+behind the same surface: proper locking instead of the free-running
+cross-thread mutation (SURVEY.md §5), task timeouts instead of the
+incomplete-board early-exit (reference node.py:462-464), a threaded HTTP
+server instead of /stats blocking behind /solve, and a configurable bind host
+instead of the hardcoded LAN IP (reference node.py:708, 726).
+"""
+
+from .wire import Msg, encode_msg, decode_msg, parse_address
+from .stats import StatsGossip
+from .membership import Membership
+from .node import P2PNode
+from .http_api import make_http_server
+
+__all__ = [
+    "Msg",
+    "encode_msg",
+    "decode_msg",
+    "parse_address",
+    "StatsGossip",
+    "Membership",
+    "P2PNode",
+    "make_http_server",
+]

@@ -1,0 +1,89 @@
+"""Node CLI — flag-compatible with the reference (reference node.py:715-730).
+
+Same four flags with the same meanings and defaults:
+  -p  HTTP port (default 8001)
+  -s  P2P/UDP port (default 7000)
+  -a  anchor node "host:port"
+  -h  handicap in ms, divided by 100 into base_delay seconds (the reference's
+      conversion, node.py:726); argparse uses conflict_handler='resolve' so
+      -h means handicap, not help, exactly as the reference does.
+
+Extensions (defaults preserve reference behavior):
+  --host        bind address (default 127.0.0.1 — the reference hardcodes its
+                authors' LAN IP 192.168.1.126, node.py:708/726, and cannot
+                start anywhere else [SURVEY.md §2 verified live]; a
+                configurable host is the defect fix)
+  --mesh-peers  N: surface N TPU-core pseudo-peers at /network (the
+                north-star mapping, BASELINE.json); default 0
+  --no-warmup   skip engine pre-compilation (faster start, slower first solve)
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="Sudoku Solver Node", conflict_handler="resolve"
+    )
+    parser.add_argument("-p", type=int, default=8001, help="HTTP port")
+    parser.add_argument("-s", type=int, default=7000, help="P2P port")
+    parser.add_argument("-a", help="Anchor node address (host:port)")
+    parser.add_argument(
+        "-h", type=float, default=1, help="Handicap (delay in ms) for validation"
+    )
+    parser.add_argument("--host", default="127.0.0.1", help="bind address")
+    parser.add_argument(
+        "--mesh-peers",
+        type=int,
+        default=0,
+        help="surface N TPU-core pseudo-peers at /network",
+    )
+    parser.add_argument("--no-warmup", action="store_true")
+    parser.add_argument(
+        "--buckets",
+        default=None,
+        help="comma-separated engine batch buckets (default 1,8,64,512,4096)",
+    )
+    return parser
+
+
+def main(argv=None) -> None:
+    from .http_api import make_http_server
+    from .node import P2PNode
+
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s - %(levelname)s - %(message)s"
+    )
+
+    engine = None
+    if args.buckets:
+        from ..engine import SolverEngine
+
+        engine = SolverEngine(
+            buckets=tuple(int(b) for b in args.buckets.split(","))
+        )
+    node = P2PNode(
+        args.host,
+        args.s,
+        anchor_node=args.a,
+        handicap=args.h / 100,
+        engine=engine,
+        mesh_peer_count=args.mesh_peers,
+    )
+    if not args.no_warmup:
+        # pre-compile the serving buckets so the first /solve is warm
+        # (p50 <5 ms contract, engine.SolverEngine.warmup)
+        threading.Thread(target=node.engine.warmup, daemon=True).start()
+
+    httpd = make_http_server(node, args.host, args.p)
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    http_thread.start()
+    try:
+        node.run()
+    finally:
+        httpd.shutdown()

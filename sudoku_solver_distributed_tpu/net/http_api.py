@@ -1,0 +1,75 @@
+"""HTTP API: POST /solve, GET /stats, GET /network — byte-identical bodies.
+
+Response contract (reference node.py:661-704):
+  POST /solve  200 → the solved grid as a JSON array-of-arrays;
+               400 → {"error": "No solution found", "solution": null}
+  GET  /stats  200 → the merged all_stats shape
+  GET  /network 200 → the all_peers dict, or {self_id: []} when alone
+  anything else 404 → {"error": "Invalid endpoint"}
+
+Fixes behind the surface: a *threading* HTTP server, so /stats and /network
+answer while a /solve is in flight (the reference's single-threaded server
+blocks them — SURVEY.md §1 [verified live]); malformed /solve bodies get a
+400 JSON error instead of the reference's uncaught exception + empty reply
+(SURVEY.md §2 HTTP row [verified live]).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger(__name__)
+
+
+class SudokuHTTPHandler(BaseHTTPRequestHandler):
+    p2p_node = None  # set by make_http_server
+
+    def _send_response(self, content, status: int = 200) -> None:
+        body = json.dumps(content).encode()
+        self.send_response(status)
+        self.send_header("Content-type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        if self.path == "/solve":
+            initial_time = time.time()
+            logger.info("received /solve POST request")
+            try:
+                content_length = int(self.headers.get("Content-Length", 0))
+                post_data = self.rfile.read(content_length)
+                sudoku = json.loads(post_data.decode("utf-8"))["sudoku"]
+            except (ValueError, KeyError, UnicodeDecodeError):
+                self._send_response({"error": "Invalid request"}, 400)
+                return
+            solution = self.p2p_node.peer_sudoku_solve(sudoku)
+            logger.info("execution time: %s", time.time() - initial_time)
+            if solution:
+                self._send_response(solution)
+            else:
+                self._send_response(
+                    {"error": "No solution found", "solution": solution}, 400
+                )
+        else:
+            self._send_response({"error": "Invalid endpoint"}, 404)
+
+    def do_GET(self):
+        if self.path == "/stats":
+            self._send_response(self.p2p_node.get_stats())
+        elif self.path == "/network":
+            self._send_response(self.p2p_node.network_view())
+        else:
+            self._send_response({"error": "Invalid endpoint"}, 404)
+
+    def log_message(self, fmt, *args):  # route http.server chatter to logging
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+def make_http_server(p2p_node, host: str, http_port: int) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (SudokuHTTPHandler,), {"p2p_node": p2p_node})
+    httpd = ThreadingHTTPServer((host, http_port), handler)
+    logger.info("HTTP server on %s:%s", host, http_port)
+    return httpd

@@ -1,0 +1,151 @@
+"""Membership & topology: anchor join, flood merge, disconnect pruning.
+
+Reproduces the reference's topology semantics (reference node.py:195-260,
+334-381, 559-577):
+
+  * a newcomer dials an anchor with ``connect``; the anchor records it in
+    ``peers_out`` and replies ``connected``; the newcomer records the anchor
+    in ``peers_in`` and notes ``all_peers[anchor] = [self]``;
+  * ``all_peers`` ({parent: [children...]}) floods on every change with a
+    grow-only union merge, until the network converges;
+  * a node with only one link opportunistically dials a second peer
+    (reference node.py:243-249);
+  * on ``disconnect`` the departed address is pruned everywhere it appears,
+    the change re-floods, and an orphaned child re-dials another node
+    (reference node.py:344-372);
+  * ``peers_to_reconnect`` tracks liveness flags exactly as the reference
+    does (True on sight, False on disconnect, revived on re-sight).
+
+The ``all_peers`` dict is the GET /network body — byte-identical shape.
+Thread-safe behind one lock (the reference mutates these sets from two
+threads, unlocked).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from .wire import Msg
+
+
+class Membership:
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self.peers_out: Set[str] = set()   # peers that dialed us
+        self.peers_in: Set[str] = set()    # peers we dialed
+        self.all_peers: Dict[str, List[str]] = {}
+        self.peers_to_reconnect: Dict[str, bool] = {}
+
+    # -- join --------------------------------------------------------------
+    def on_connect(self, address: str) -> None:
+        """Inbound ``connect`` (we are the anchor side)."""
+        with self._lock:
+            self.peers_out.add(address)
+            self.peers_to_reconnect[address] = True
+
+    def on_connected(self, address: str) -> None:
+        """Inbound ``connected`` (our dial was accepted)."""
+        with self._lock:
+            self.peers_in.add(address)
+            self.peers_to_reconnect[address] = True
+            self.all_peers[address] = [self.node_id]
+
+    # -- flood merge -------------------------------------------------------
+    def merge_all_peers(self, received: Dict[str, List[str]]) -> bool:
+        """Grow-only union merge; True if our view changed (=> re-flood)."""
+        changed = False
+        with self._lock:
+            for parent, children in received.items():
+                if parent not in self.all_peers:
+                    self.all_peers[parent] = list(children)
+                    changed = True
+                else:
+                    merged = sorted(set(self.all_peers[parent]) | set(children))
+                    if merged != sorted(self.all_peers[parent]):
+                        self.all_peers[parent] = merged
+                        changed = True
+            # revive liveness flags for any address we can now see
+            for parent, children in self.all_peers.items():
+                for addr in (parent, *children):
+                    if self.peers_to_reconnect.get(addr) is False:
+                        self.peers_to_reconnect[addr] = True
+        return changed
+
+    def second_link_target(self) -> Optional[str]:
+        """If singly-connected, an address worth dialing for redundancy
+        (reference node.py:243-249)."""
+        with self._lock:
+            if not (len(self.peers_in) == 1 or len(self.peers_out) == 1):
+                return None
+            for parent in self.all_peers:
+                if (
+                    parent not in self.peers_in
+                    and parent not in self.peers_out
+                    and parent != self.node_id
+                ):
+                    return parent
+        return None
+
+    # -- departure ---------------------------------------------------------
+    def on_disconnect(self, address: str) -> tuple[bool, Optional[str]]:
+        """Prune a departed peer.
+
+        Returns (changed, redial): changed => our all_peers view shrank and
+        should re-flood; redial is an address to dial if the departed peer
+        was our parent (orphan re-join, reference node.py:360-372).
+        """
+        redial: Optional[str] = None
+        with self._lock:
+            self.peers_in.discard(address)
+            self.peers_out.discard(address)
+
+            before = {k: list(v) for k, v in self.all_peers.items()}
+            was_parent_of_us = address in before and self.node_id in before[address]
+
+            for parent in list(self.all_peers):
+                children = self.all_peers[parent]
+                if address in children:
+                    children.remove(address)
+                    if not children:
+                        del self.all_peers[parent]
+            self.all_peers.pop(address, None)
+            changed = before != self.all_peers
+
+            if changed:
+                self.peers_to_reconnect[address] = False
+
+            if was_parent_of_us:
+                if self.all_peers:
+                    redial = next(iter(self.all_peers))
+                else:
+                    for sibling in before.get(address, []):
+                        if sibling != self.node_id:
+                            redial = sibling
+                            break
+        return changed, redial
+
+    # -- views -------------------------------------------------------------
+    def neighbors(self) -> List[str]:
+        """Directly-connected peers (the flood/gossip fan-out set,
+        reference node.py:574, 593)."""
+        with self._lock:
+            return list(self.peers_out) + list(self.peers_in)
+
+    def total_peers(self) -> List[str]:
+        """Every known address except ourselves (the task-farm worker pool,
+        reference node.py:251-260)."""
+        with self._lock:
+            total = set(self.all_peers.keys())
+            for children in self.all_peers.values():
+                total.update(children)
+            total.discard(self.node_id)
+            return sorted(total)
+
+    def network_view(self) -> Dict[str, List[str]]:
+        """The GET /network body (reference node.py:696-702)."""
+        with self._lock:
+            if self.all_peers:
+                return {k: list(v) for k, v in self.all_peers.items()}
+            return {self.node_id: []}

@@ -1,0 +1,396 @@
+"""P2P node: UDP event loop, task farm, gossip — the reference's node.py rebuilt.
+
+One node = one process exposing (a) the UDP JSON peer protocol and (b) the
+HTTP API (http_api.py), sharing this object (reference node.py:134-658). The
+solving engine behind it is the TPU batch solver (engine.SolverEngine) instead
+of the reference's greedy per-cell Python probe.
+
+Wire behavior preserved: anchor join & flood membership, opportunistic second
+link, task dispatch one-cell-per-peer with ``solve``/``solution`` messages,
+stats gossip on the same triggers (join / worker task / master solve /
+shutdown), graceful disconnect carrying the in-flight task. Defects fixed
+behind the same surface (SURVEY.md §7 fidelity boundary): locks + condition
+variables instead of unsynchronized cross-thread mutation and busy-waiting
+(reference node.py:554-555), task deadlines + requeue instead of silently
+returning incomplete boards (reference node.py:462-464), failure correctly
+reported instead of counted as solved (reference node.py:465-475), and an
+engine-authoritative fallback instead of the lossy swap-repair heuristic
+(reference node.py:487-532).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..engine import SolverEngine
+from ..utils import HandicapLimiter
+from . import wire
+from .membership import Membership
+from .stats import StatsGossip
+
+logger = logging.getLogger(__name__)
+
+TASK_DEADLINE_S = 5.0       # reassign a dispatched cell after this long
+SOLVE_WAIT_SLICE_S = 0.05   # condition-wait granularity in the dispatch loop
+GOSSIP_INTERVAL_S = 1.0     # periodic stats broadcast (see P2PNode.run)
+
+
+class P2PNode:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        anchor_node: Optional[str] = None,
+        handicap: float = 0.001,
+        engine: Optional[SolverEngine] = None,
+        mesh_peer_count: int = 0,
+    ):
+        self.host = host
+        self.port = port
+        self.id = f"{host}:{port}"
+        self.anchor_node = anchor_node
+        self.handicap = handicap
+
+        self.engine = engine if engine is not None else SolverEngine()
+        self.limiter = HandicapLimiter(base_delay=handicap)
+        self._solved_count = 0
+        self.membership = Membership(self.id)
+        self.stats = StatsGossip(self.id, self._own_counters)
+
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.shutdown_flag = False
+
+        # master-side task farm state (one solve in flight at a time, like the
+        # reference; guarded properly here)
+        self._solve_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._solution_event = threading.Condition(self._state_lock)
+        self.task_queue: deque = deque()
+        self.active_tasks: Dict[str, Tuple[int, int, float]] = {}
+        self.solution_queue: deque = deque()
+
+        # worker-side: the task currently being computed (for the disconnect
+        # message's row/col fields, reference node.py:651-654)
+        self._current_task: Optional[Tuple[int, int]] = None
+
+        # TPU pseudo-peers surfaced at /network when enabled (north-star
+        # mapping: each reported peer ≙ one TPU core, BASELINE.json)
+        self.mesh_peer_ids: List[str] = [
+            f"{self.id}/tpu{k}" for k in range(mesh_peer_count)
+        ]
+
+    # -- counters ----------------------------------------------------------
+    # `solved` counts one per successful master solve (reference node.py:468
+    # — minus its count-failures-as-solved defect); `validations` is the
+    # engine's device sweep count, which naturally lands on whichever node
+    # did the work (workers included), matching the reference's distributed
+    # per-node validations accounting.
+    def _own_counters(self) -> tuple:
+        return self._solved_count, self.engine.validations
+
+    @property
+    def validations(self) -> int:
+        return self.engine.validations
+
+    @property
+    def solved_puzzles(self) -> int:
+        return self._solved_count
+
+    # -- transport ---------------------------------------------------------
+    def send(self, address, msg: wire.Msg) -> None:
+        try:
+            self.sock.sendto(wire.encode_msg(msg), address)
+        except OSError as e:
+            logger.error("send to %s failed: %s", address, e)
+
+    def send_to(self, peer_id: str, msg: wire.Msg) -> None:
+        self.send(wire.parse_address(peer_id), msg)
+
+    def recv(self):
+        try:
+            payload, addr = self.sock.recvfrom(wire.RECV_BUFFER)
+            return (payload or None), addr
+        except socket.timeout:
+            return None, None
+        except OSError:
+            return None, None
+
+    # -- gossip ------------------------------------------------------------
+    def broadcast_all_peers(self) -> None:
+        msg = wire.all_peers_msg(self.membership.network_view())
+        for peer in self.membership.neighbors():
+            self.send_to(peer, msg)
+
+    def broadcast_stats(self) -> None:
+        snap = self.stats.snapshot()
+        msg = wire.stats_msg(
+            self.id, self._solved_count, self.engine.validations, snap
+        )
+        for peer in self.membership.neighbors():
+            self.send_to(peer, msg)
+
+    def get_stats(self) -> wire.Msg:
+        return self.stats.snapshot()
+
+    def network_view(self) -> wire.Msg:
+        view = self.membership.network_view()
+        if self.mesh_peer_ids:
+            view.setdefault(self.id, [])
+            view[self.id] = sorted(set(view[self.id]) | set(self.mesh_peer_ids))
+        return view
+
+    # -- message dispatch ---------------------------------------------------
+    def handle_message(self, msg: wire.Msg) -> None:
+        mtype = msg.get("type")
+        if mtype == "connect":
+            self.membership.on_connect(msg["address"])
+            self.send_to(msg["address"], wire.connected_msg(self.id))
+
+        elif mtype == "connected":
+            self.membership.on_connected(msg["address"])
+            self.broadcast_all_peers()
+
+        elif mtype == "all_peers":
+            self.broadcast_stats()  # same trigger as reference node.py:217
+            if self.membership.merge_all_peers(msg["all_peers"]):
+                self.broadcast_all_peers()
+            target = self.membership.second_link_target()
+            if target is not None:
+                self.send_to(target, wire.connect_msg(self.id))
+
+        elif mtype == "stats":
+            self.stats.merge(msg)
+
+        elif mtype == "disconnect":
+            self._on_disconnect(msg)
+
+        elif mtype == "solve":
+            self._on_solve_task(msg)
+
+        elif mtype == "solution":
+            with self._state_lock:
+                self.solution_queue.append(
+                    (msg["row"], msg["col"], msg["solution"], msg["address"])
+                )
+                self._solution_event.notify_all()
+
+        else:
+            logger.warning("unknown message type: %r", mtype)
+
+    def _on_disconnect(self, msg: wire.Msg) -> None:
+        address = msg["address"]
+        # a departing peer hands back its in-flight cell (reference
+        # node.py:335-337)
+        if "row" in msg and "col" in msg:
+            with self._state_lock:
+                self.task_queue.appendleft((msg["row"], msg["col"]))
+                self._solution_event.notify_all()
+        changed, redial = self.membership.on_disconnect(address)
+        if changed:
+            if self.membership.all_peers:
+                self.broadcast_all_peers()
+            # Relay the departure to our other neighbors. The reference only
+            # tells a departed peer's direct neighbors, and its grow-only
+            # all_peers merge cannot carry deletions, so every other node
+            # lists the dead peer forever (SURVEY.md §3.5 [verified live]).
+            # Flooding the same wire message (minus the row/col task fields,
+            # which only the direct master may requeue) propagates the
+            # deletion; a second receipt changes nothing, so the flood
+            # terminates.
+            for peer in self.membership.neighbors():
+                if peer != address:
+                    self.send_to(peer, wire.disconnect_msg(address))
+        if redial is not None:
+            self.send_to(redial, wire.connect_msg(self.id))
+        with self._state_lock:
+            if address in self.active_tasks:
+                # its assignment is gone; requeue unless the disconnect already did
+                row, col, _ = self.active_tasks.pop(address)
+                if "row" not in msg:
+                    self.task_queue.appendleft((row, col))
+                self._solution_event.notify_all()
+
+    # -- worker side -------------------------------------------------------
+    def _on_solve_task(self, msg: wire.Msg) -> None:
+        """Answer one cell of a dispatched board (reference node.py:384-406).
+
+        The reference worker probes greedily for the first non-conflicting
+        value (node.py:76-80) — often wrong, forcing the master into repair
+        churn. This worker solves the *whole* board on the TPU engine and
+        returns the cell's value from an actual solution: correct by
+        construction, None only if the dispatched board is unsatisfiable.
+        """
+        row, col, board, origin = msg["row"], msg["col"], msg["sudoku"], msg["address"]
+        self._current_task = (row, col)
+        try:
+            self.limiter.tick()  # the handicap contract, one tick per task
+            solution, _ = self.engine.solve_one(board)
+            value = solution[row][col] if solution is not None else None
+            self.send_to(
+                origin, wire.solution_msg(board, row, col, value, self.id)
+            )
+        finally:
+            self._current_task = None
+        self.broadcast_stats()  # same trigger as reference node.py:406
+
+    # -- master side -------------------------------------------------------
+    def peer_sudoku_solve(self, sudoku) -> Optional[list]:
+        """Solve a request board, farming cells to peers when there are any
+        (reference node.py:534-557). Returns the solved grid or None."""
+        with self._solve_lock:
+            peers = [p for p in self.membership.total_peers()]
+            if not peers:
+                solution, _ = self.engine.solve_one(sudoku)
+            else:
+                solution = self._farm_solve(sudoku, peers)
+            if solution is not None:
+                self._solved_count += 1
+            self.broadcast_stats()
+            return solution
+
+    def _farm_solve(self, sudoku, peers: List[str]) -> Optional[list]:
+        board = [list(r) for r in sudoku]
+        with self._state_lock:
+            self.task_queue.clear()
+            self.solution_queue.clear()
+            self.active_tasks.clear()
+            for i in range(len(board)):
+                for j in range(len(board)):
+                    if board[i][j] == 0:
+                        self.task_queue.append((i, j))
+
+        while True:
+            with self._state_lock:
+                # reap deadlined assignments (dead/slow peers: the failure
+                # mode the reference cannot detect, SURVEY.md §3.5)
+                now = time.monotonic()
+                for peer in list(self.active_tasks):
+                    row, col, deadline = self.active_tasks[peer]
+                    if now > deadline:
+                        logger.warning(
+                            "task (%d,%d) on %s timed out; requeueing", row, col, peer
+                        )
+                        del self.active_tasks[peer]
+                        self.task_queue.appendleft((row, col))
+
+                # dispatch one cell per idle peer (reference node.py:433-442)
+                live = set(self.membership.total_peers()) or set(peers)
+                for peer in sorted(live):
+                    if not self.task_queue:
+                        break
+                    if peer in self.active_tasks:
+                        continue
+                    i, j = self.task_queue.popleft()
+                    self.active_tasks[peer] = (i, j, now + TASK_DEADLINE_S)
+                    self.send_to(
+                        peer, wire.solve_msg(board, i, j, self.id)
+                    )
+
+                # fold in any arrived solutions
+                requeued_none = False
+                while self.solution_queue:
+                    row, col, value, peer = self.solution_queue.popleft()
+                    self.active_tasks.pop(peer, None)
+                    if value is None:
+                        requeued_none = True
+                        continue
+                    if board[row][col] != 0:
+                        continue  # duplicate/stale answer
+                    if self._placement_ok(board, row, col, value):
+                        board[row][col] = value
+                    else:
+                        self.task_queue.appendleft((row, col))
+
+                done = not self.task_queue and not self.active_tasks
+                if not done:
+                    self._solution_event.wait(timeout=SOLVE_WAIT_SLICE_S)
+
+            if requeued_none:
+                # a worker proved its (possibly mixed-merge) board unsat: fall
+                # back to the authoritative engine on the original request —
+                # replaces the reference's swap-repair (node.py:487-532)
+                solution, _ = self.engine.solve_one(sudoku)
+                return solution
+
+            if done:
+                break
+
+        if any(0 in row for row in board):
+            return None
+        # strict final check on the engine (reference runs its weak check,
+        # node.py:466)
+        solution, _ = self.engine.solve_one(board)
+        return solution
+
+    @staticmethod
+    def _placement_ok(board, row, col, value) -> bool:
+        n = len(board)
+        box = int(round(n ** 0.5))
+        if not 1 <= value <= n:
+            return False
+        for k in range(n):
+            if board[row][k] == value or board[k][col] == value:
+                return False
+        bi, bj = (row // box) * box, (col // box) * box
+        for i in range(bi, bi + box):
+            for j in range(bj, bj + box):
+                if board[i][j] == value:
+                    return False
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect_to_anchor_node(self) -> None:
+        logger.info("connecting to anchor node %s", self.anchor_node)
+        self.send(wire.parse_address(self.anchor_node), wire.connect_msg(self.id))
+
+    def run(self) -> None:
+        """UDP event loop (main thread, reference node.py:623-644)."""
+        self.sock.bind((self.host, self.port))
+        self.sock.settimeout(0.5)  # periodic wake: anchor retry & clean shutdown
+        logger.info("P2P node %s listening on %s:%s", self.id, self.host, self.port)
+        last_anchor_try = 0.0
+        last_gossip = 0.0
+        while not self.shutdown_flag:
+            try:
+                # Periodic stats gossip. The reference only gossips on events
+                # (join / task / solve / shutdown, node.py:217, 406, 556, 647)
+                # so counters stall on quiet networks; a time trigger keeps
+                # /stats eventually consistent everywhere using the same
+                # message type, and doubles as a liveness heartbeat.
+                if (
+                    time.monotonic() - last_gossip > GOSSIP_INTERVAL_S
+                    and self.membership.neighbors()
+                ):
+                    self.broadcast_stats()
+                    last_gossip = time.monotonic()
+                # retry the anchor until the join took (the reference blocks
+                # forever if the anchor isn't up yet, node.py:559-568)
+                if (
+                    self.anchor_node
+                    and not self.membership.neighbors()
+                    and time.monotonic() - last_anchor_try > 2.0
+                ):
+                    self.connect_to_anchor_node()
+                    last_anchor_try = time.monotonic()
+                payload, _ = self.recv()
+                if payload is None:
+                    continue
+                self.handle_message(wire.decode_msg(payload))
+            except KeyboardInterrupt:
+                self.shutdown()
+            except Exception as e:  # a malformed datagram must not kill the node
+                logger.error("error handling datagram: %s", e)
+
+    def shutdown(self) -> None:
+        """Graceful departure (reference node.py:646-658)."""
+        self.broadcast_stats()
+        self.shutdown_flag = True
+        for peer in self.membership.neighbors():
+            self.send_to(peer, wire.disconnect_msg(self.id, self._current_task))
+            logger.info("sent disconnect message to %s", peer)
+        logger.info("shutting down P2P node %s", self.id)

@@ -1,0 +1,101 @@
+"""UDP wire protocol: the reference's 7 JSON message types, byte-identical.
+
+Message constructors pin the exact field *order* the reference emits (JSON
+object key order is insertion order under json.dumps), so a capture of this
+node's traffic is indistinguishable from the reference's:
+
+  connect     {"type", "address"}                      reference node.py:563
+  connected   {"type", "address"}                      reference node.py:199
+  all_peers   {"type", "all_peers"}                    reference node.py:573
+  disconnect  {"type", "address"[, "row", "col"]}      reference node.py:652-654
+  solve       {"type", "sudoku", "row", "col", "address"}   reference node.py:441
+  solution    {"type", "sudoku", "col", "row", "solution", "address"}
+              (note: "col" BEFORE "row" — the reference really does emit this
+              order, node.py:402)
+  stats       {"type", "origin", "solved", "stats": {"address", "validations"},
+               "all_stats"}                            reference node.py:583-592
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+
+Msg = Dict[str, Any]
+
+# Wire cap: the reference reads 1024-byte datagrams (node.py:183) which is a
+# scaling cliff for big boards/member lists; we speak the same protocol but
+# read up to 64 KiB (a 25×25 solve message is ~2.6 KB). Datagrams we *send*
+# that exceed the reference's buffer would be truncated by a reference
+# receiver, so interop with actual reference nodes holds for 9×9 traffic.
+RECV_BUFFER = 65536
+
+
+def parse_address(address: str) -> Tuple[str, int]:
+    """"host:port" → (host, port)."""
+    host, port = address.rsplit(":", 1)
+    return host, int(port)
+
+
+def encode_msg(msg: Msg) -> bytes:
+    return json.dumps(msg).encode()
+
+
+def decode_msg(payload: bytes) -> Msg:
+    return json.loads(payload.decode())
+
+
+# -- constructors (field order = reference emission order) ------------------
+
+def connect_msg(self_address: str) -> Msg:
+    return {"type": "connect", "address": self_address}
+
+
+def connected_msg(self_address: str) -> Msg:
+    return {"type": "connected", "address": self_address}
+
+
+def all_peers_msg(all_peers: Dict[str, list]) -> Msg:
+    return {"type": "all_peers", "all_peers": all_peers}
+
+
+def disconnect_msg(self_address: str, task: Optional[Tuple[int, int]] = None) -> Msg:
+    if task is None:
+        return {"type": "disconnect", "address": self_address}
+    return {
+        "type": "disconnect",
+        "address": self_address,
+        "row": task[0],
+        "col": task[1],
+    }
+
+
+def solve_msg(sudoku, row: int, col: int, self_address: str) -> Msg:
+    return {
+        "type": "solve",
+        "sudoku": sudoku,
+        "row": row,
+        "col": col,
+        "address": self_address,
+    }
+
+
+def solution_msg(sudoku, row: int, col: int, solution, self_address: str) -> Msg:
+    return {
+        "type": "solution",
+        "sudoku": sudoku,
+        "col": col,
+        "row": row,
+        "solution": solution,
+        "address": self_address,
+    }
+
+
+def stats_msg(origin: str, solved: int, validations: int, all_stats: Msg) -> Msg:
+    return {
+        "type": "stats",
+        "origin": origin,
+        "solved": solved,
+        "stats": {"address": origin, "validations": validations},
+        "all_stats": all_stats,
+    }

@@ -148,6 +148,50 @@ def _step(state: _State, spec: BoardSpec) -> _State:
     )
 
 
+def init_state(
+    grid: jnp.ndarray, spec: BoardSpec, max_depth: int | None = None
+) -> _State:
+    """Fresh solver state for a (B, N, N) batch (public for engines that run
+    the step loop themselves, e.g. the sharded frontier racer in
+    parallel/frontier.py which interleaves steps with mesh collectives)."""
+    B = grid.shape[0]
+    C = spec.cells
+    D = max_depth if max_depth is not None else spec.max_depth
+    return _State(
+        grid=grid.astype(jnp.int32).reshape(B, C),
+        stack_grid=jnp.zeros((B, D, C), jnp.int8),
+        stack_cell=jnp.zeros((B, D), jnp.int32),
+        stack_mask=jnp.zeros((B, D), jnp.int32),
+        depth=jnp.zeros((B,), jnp.int32),
+        status=jnp.zeros((B,), jnp.int32),
+        guesses=jnp.zeros((B,), jnp.int32),
+        validations=jnp.zeros((B,), jnp.int32),
+        iters=jnp.int32(0),
+    )
+
+
+def step(state: _State, spec: BoardSpec) -> _State:
+    """One lockstep solver iteration over the batch (public; see init_state)."""
+    return _step(state, spec)
+
+
+def finalize_status(state: _State, spec: BoardSpec) -> _State:
+    """Flip RUNNING → SOLVED for boards completed on the very last step.
+
+    ``_step`` evaluates solved-ness from the grid *before* this iteration's
+    assignments, so a board finished exactly at an iteration cap would
+    otherwise be reported RUNNING while holding a complete valid grid. One
+    extra analysis outside the loop closes the gap.
+    """
+    N = spec.size
+    B = state.grid.shape[0]
+    a = analyze(state.grid.reshape(B, N, N), spec)
+    status = jnp.where(
+        (state.status == RUNNING) & a.solved, SOLVED, state.status
+    )
+    return state._replace(status=status)
+
+
 def solve_batch(
     grid: jnp.ndarray,
     spec: BoardSpec,
@@ -166,25 +210,13 @@ def solve_batch(
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """
     B = grid.shape[0]
-    C = spec.cells
-    D = max_depth if max_depth is not None else spec.max_depth
-
-    state = _State(
-        grid=grid.astype(jnp.int32).reshape(B, C),
-        stack_grid=jnp.zeros((B, D, C), jnp.int8),
-        stack_cell=jnp.zeros((B, D), jnp.int32),
-        stack_mask=jnp.zeros((B, D), jnp.int32),
-        depth=jnp.zeros((B,), jnp.int32),
-        status=jnp.zeros((B,), jnp.int32),
-        guesses=jnp.zeros((B,), jnp.int32),
-        validations=jnp.zeros((B,), jnp.int32),
-        iters=jnp.int32(0),
-    )
+    state = init_state(grid, spec, max_depth)
 
     def cond(s: _State):
         return (s.status == RUNNING).any() & (s.iters < max_iters)
 
     state = jax.lax.while_loop(cond, lambda s: _step(s, spec), state)
+    state = finalize_status(state, spec)
 
     N = spec.size
     return SolveResult(

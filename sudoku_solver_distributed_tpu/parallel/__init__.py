@@ -1,0 +1,24 @@
+"""Device-mesh execution: data-parallel batch solving and sharded search frontiers.
+
+The reference's parallelism is a master/worker task farm over UDP peers
+(reference node.py:427-475). The TPU-native redesign has two axes:
+
+  * **data parallel** (shard.py): the puzzle batch sharded over the mesh's
+    ``data`` axis — the throughput path (each "/network peer" ≙ one chip);
+  * **search-frontier parallel** (frontier.py): ONE hard board's speculative
+    DFS subtrees sharded across chips, racing to a solution with an
+    early-exit collective — this workload's analog of sequence/context
+    parallelism (SURVEY.md §5: the search frontier is the sequence axis).
+"""
+
+from .mesh import default_mesh, data_sharding
+from .shard import make_sharded_solver
+from .frontier import frontier_solve, seed_frontier
+
+__all__ = [
+    "default_mesh",
+    "data_sharding",
+    "make_sharded_solver",
+    "frontier_solve",
+    "seed_frontier",
+]

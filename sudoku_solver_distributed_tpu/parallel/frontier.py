@@ -1,0 +1,209 @@
+"""Sharded speculative-DFS frontier: one hard board raced across the mesh.
+
+This is the framework's long-context / sequence-parallel story (SURVEY.md §5:
+the *search frontier* is this workload's sequence axis). Where the reference
+ships one cell per UDP peer (reference node.py:433-442), here the board's
+search *space* is partitioned: a host-side seeding pass expands the root into
+many disjoint subtrees (k-way splits on minimum-remaining-values cells), the
+subtrees are sharded across the ``data`` mesh axis, and every chip runs the
+DFS kernel on its shard in lockstep — with a one-scalar ``psum`` each
+iteration so that the instant any chip finds a solution, every chip stops
+(the early-exit collective replaces the reference's master busy-wait,
+node.py:554-555). Solution extraction is an ``all_gather`` + lowest-rank
+pick, deterministic regardless of which chip won.
+
+Scales to pod slices unchanged: the mesh may span hosts (ICI within a slice,
+DCN across), and the per-iteration collective is a single int32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import BoardSpec, SPEC_9
+from ..ops.propagate import analyze
+from ..ops.encode import mask_to_value
+from ..ops import solver as S
+from .mesh import default_mesh
+
+
+def _unsat_pad(spec: BoardSpec) -> np.ndarray:
+    """A trivially contradictory board — frontier padding that dies in one step."""
+    board = np.zeros((spec.size, spec.size), np.int32)
+    board[0, 0] = 1
+    board[0, 1] = 1
+    return board
+
+
+def seed_frontier(
+    board: np.ndarray,
+    spec: BoardSpec = SPEC_9,
+    *,
+    target: int = 64,
+    max_rounds: Optional[int] = None,
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Expand one board into ≥``target`` disjoint speculative states.
+
+    Host-driven BFS: propagate all current states on device, drop
+    contradictions, then k-way split each state on its MRV cell (one child per
+    candidate value — children partition the parent's solution space exactly).
+    Stops early if propagation alone solves the board.
+
+    Returns (states, solved): states is (M, N, N) with M ≥ target unless the
+    search space is exhausted (then padded with instantly-unsat boards so the
+    shape contract holds); solved is the solution if one fell out during
+    seeding, else None.
+    """
+    if max_rounds is None:
+        # each round either assigns singles (≤ cells of them) or splits
+        max_rounds = spec.cells + 16
+    states = np.asarray(board, np.int32)[None]
+    analyze_j = jax.jit(partial(analyze, spec=spec))
+    assign_j = jax.jit(
+        lambda g, a: jnp.where((g == 0) & (a != 0), mask_to_value(a), g)
+    )
+
+    for _ in range(max_rounds):
+        a = analyze_j(jnp.asarray(states))
+        solved = np.asarray(a.solved)
+        if solved.any():
+            return states, states[int(np.argmax(solved))]
+        live = ~np.asarray(a.contradiction)
+        if not live.any():
+            # unsat root: hand back dead boards; the solver will report UNSAT
+            break
+        assign = np.asarray(a.assign)
+        if (assign[live] != 0).any():
+            # propagate singles everywhere before splitting
+            states = np.asarray(assign_j(jnp.asarray(states), jnp.asarray(assign)))
+            states = states[live]
+            continue
+        states = states[live]
+        if len(states) >= target:
+            return states, None
+        # k-way split every state on its MRV cell
+        cand = np.asarray(a.cand)[live].reshape(len(states), -1)
+        pc = np.asarray(
+            jax.lax.population_count(jnp.asarray(cand))
+        )
+        pc = np.where(cand != 0, pc, 10**6)
+        cells = pc.argmin(axis=1)
+        children = []
+        for s_idx, cell in enumerate(cells):
+            mask = int(cand[s_idx, cell])
+            if mask == 0:  # fully filled (would have been solved) — keep as-is
+                children.append(states[s_idx])
+                continue
+            i, j = divmod(int(cell), spec.size)
+            while mask:
+                bit = mask & -mask
+                mask &= ~bit
+                child = states[s_idx].copy()
+                child[i, j] = bit.bit_length()
+                children.append(child)
+        states = np.stack(children)
+
+    if len(states) < target:
+        pad = np.broadcast_to(
+            _unsat_pad(spec), (target - len(states), spec.size, spec.size)
+        )
+        states = np.concatenate([states, pad], axis=0)
+    return states, None
+
+
+def _make_racer(mesh, spec: BoardSpec, max_iters: int, max_depth: Optional[int]):
+    """Compile the shard_map race: lockstep DFS with per-iteration early exit."""
+
+    from jax.sharding import PartitionSpec as P
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("data"),),
+        out_specs=(P(), P(), P()),
+        check_vma=False,  # while_loop carry starts unvarying (see shard.py)
+    )
+    def race(states):  # (K, N, N) per device
+        st = S.init_state(states, spec, max_depth)
+
+        def cond(carry):
+            st, found = carry
+            local_live = (st.status == S.RUNNING).any()
+            any_live = jax.lax.psum(local_live.astype(jnp.int32), "data") > 0
+            return ~found & any_live & (st.iters < max_iters)
+
+        def body(carry):
+            st, _ = carry
+            st = S.step(st, spec)
+            local_hit = (st.status == S.SOLVED).any()
+            found = jax.lax.psum(local_hit.astype(jnp.int32), "data") > 0
+            return st, found
+
+        st, found = jax.lax.while_loop(cond, body, (st, jnp.bool_(False)))
+        st = S.finalize_status(st, spec)  # catch boards solved on the last step
+
+        # deterministic extraction: lowest-rank device with a solution wins
+        K = states.shape[0]
+        local_solved = st.status == S.SOLVED
+        local_has = local_solved.any()
+        idx = jnp.argmax(local_solved)
+        local_sol = jnp.where(
+            local_has, st.grid[idx], jnp.zeros_like(st.grid[0])
+        )
+        has_g = jax.lax.all_gather(local_has, "data")        # (n_dev,)
+        sol_g = jax.lax.all_gather(local_sol, "data")        # (n_dev, C)
+        winner = jnp.argmax(has_g)  # first True, or 0 if none
+        solution = sol_g[winner].reshape(spec.size, spec.size)
+        found_any = has_g.any()
+        validations = jax.lax.psum(st.validations.sum(), "data")
+        return solution, found_any, validations
+
+    return jax.jit(race)
+
+
+def frontier_solve(
+    board,
+    mesh=None,
+    spec: BoardSpec = SPEC_9,
+    *,
+    states_per_device: int = 64,
+    max_iters: int = 65536,
+    max_depth: Optional[int] = None,
+) -> Tuple[Optional[list], dict]:
+    """Solve one (hard) board by racing its search subtrees across the mesh.
+
+    Returns (solution | None, info). info carries 'validations' (total sweep
+    count over all chips) and 'seeded' (number of speculative states).
+    """
+    mesh = mesh if mesh is not None else default_mesh()
+    n_dev = mesh.devices.size
+    target = n_dev * states_per_device
+
+    board = np.asarray(board, np.int32)
+    states, early = seed_frontier(board, spec, target=target)
+    if early is not None:
+        return early.tolist(), {"validations": 0, "seeded": len(states)}
+
+    # Never drop a seeded state — each covers a disjoint slice of the search
+    # space, so dropping one could lose the only solution. Round the count up
+    # to a multiple of the mesh with instantly-unsat padding instead.
+    K = -(-len(states) // n_dev)  # ceil
+    total = n_dev * K
+    if len(states) < total:
+        pad = np.broadcast_to(
+            _unsat_pad(spec), (total - len(states), spec.size, spec.size)
+        )
+        states = np.concatenate([states, pad], axis=0)
+    racer = _make_racer(mesh, spec, max_iters, max_depth)
+    sol, found, validations = racer(jnp.asarray(states))
+    if not bool(found):
+        return None, {"validations": int(validations), "seeded": len(states)}
+    return np.asarray(sol).tolist(), {
+        "validations": int(validations),
+        "seeded": len(states),
+    }

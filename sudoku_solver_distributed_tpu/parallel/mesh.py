@@ -1,0 +1,32 @@
+"""Mesh construction and sharding helpers.
+
+One mesh axis, ``data``, carries both parallel modes: puzzle batches are
+sharded along it (shard.py) and so are speculative search states
+(frontier.py). Multi-host pods extend the same mesh transparently —
+``jax.devices()`` spans all hosts once ``jax.distributed.initialize`` has run
+(net/cluster.py), and XLA routes the collectives over ICI within a slice and
+DCN across slices; nothing here changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D ``data`` mesh over all (or the given) devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), axis_names=("data",))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch / frontier-state) axis over ``data``."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,120 @@
+"""Tests for the host-facing Sudoku class (api.py) — reference sudoku.py parity."""
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.api import Sudoku
+from sudoku_solver_distributed_tpu.models import oracle_solve
+
+GOOD = [
+    [8, 9, 7, 1, 2, 4, 6, 3, 5],
+    [5, 3, 1, 6, 7, 9, 2, 8, 4],
+    [6, 4, 2, 3, 8, 5, 1, 7, 9],
+    [1, 5, 4, 2, 9, 3, 8, 6, 7],
+    [2, 8, 9, 7, 1, 6, 4, 5, 3],
+    [3, 7, 6, 4, 5, 8, 9, 1, 2],
+    [9, 2, 3, 8, 6, 7, 5, 4, 1],
+    [7, 6, 5, 9, 4, 1, 3, 2, 8],
+    [4, 1, 8, 5, 3, 2, 7, 9, 6],
+]
+
+
+def fast(board):
+    """A Sudoku with the handicap disabled (base_delay=0)."""
+    return Sudoku(board, base_delay=0.0)
+
+
+def test_check_good_board():
+    assert fast(GOOD).check()
+
+
+def test_check_weak_board_rejected():
+    # all-5s rows sum to 45; the strict contract must reject them
+    assert not fast([[5] * 9 for _ in range(9)]).check()
+
+
+def test_check_row_col_square():
+    s = fast(GOOD)
+    for i in range(9):
+        assert s.check_row(i)
+        assert s.check_column(i)
+    for i in range(3):
+        for j in range(3):
+            assert s.check_square(i * 3, j * 3)
+    bad = [row[:] for row in GOOD]
+    bad[4][4] = bad[4][5]
+    s = fast(bad)
+    assert not s.check_row(4)
+    assert not s.check_square(3, 3)
+    assert s.check_row(0)
+
+
+def test_check_is_valid_semantics(readme_puzzle):
+    s = fast(readme_puzzle)
+    # (0,3) holds 1; a 1 anywhere in row 0 conflicts — including at (0,3) itself
+    assert not s.check_is_valid(0, 0, 1)
+    assert not s.check_is_valid(0, 3, 1)
+    # 5 appears nowhere near (0,0) in this 8-clue puzzle
+    assert s.check_is_valid(0, 0, 5)
+
+
+def test_validations_counter_and_handicap():
+    sleeps = []
+    s = Sudoku(GOOD, base_delay=0.01, threshold=2)
+    s._limiter._sleep = sleeps.append  # observe instead of sleeping
+    assert s.check() is True
+    # one rate-limited tick per unit: 9 rows + 9 cols + 9 boxes
+    assert s.validations == 27
+    # sliding-window throttle engaged after the threshold
+    assert len(sleeps) == 27 - 2
+    # delay grows with the excess count (reference sudoku.py:28-29 formula)
+    assert sleeps[0] == pytest.approx(0.01 * (3 - 2 + 1))
+
+
+def test_check_short_circuits_counting():
+    bad = [row[:] for row in GOOD]
+    bad[0][0] = bad[0][1]  # row 0 invalid
+    s = fast(bad)
+    assert not s.check()
+    assert s.validations == 1  # stopped at the first failing unit
+
+
+def test_update_helpers():
+    s = fast([[0] * 9 for _ in range(9)])
+    s.update_row(2, list(range(1, 10)))
+    assert s.grid[2] == list(range(1, 10))
+    s.update_column(0, list(range(9, 0, -1)))
+    assert [s.grid[r][0] for r in range(9)] == list(range(9, 0, -1))
+
+
+def test_str_highlights_zeros(readme_puzzle):
+    out = str(fast(readme_puzzle))
+    assert "\033[93m0\033[0m" in out
+    assert out.startswith("| - - - - - - - - - - - |")
+
+
+def test_engine_solve_one(readme_puzzle):
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+
+    eng = SolverEngine(buckets=(1, 8))
+    sol, info = eng.solve_one(readme_puzzle)
+    assert sol is not None
+    assert oracle_solve(readme_puzzle) is not None
+    assert Sudoku(sol, base_delay=0).check()
+    assert info["validations"] >= 1
+    assert eng.solved_puzzles == 1
+
+    unsat = np.zeros((9, 9), np.int32)
+    unsat[0, 0] = 10
+    sol, _ = eng.solve_one(unsat)
+    assert sol is None
+
+
+def test_engine_batch_buckets():
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    eng = SolverEngine(buckets=(4,))  # force tiling: 10 boards over bucket 4
+    boards = generate_batch(10, 30, seed=6)
+    sols, mask, info = eng.solve_batch_np(boards)
+    assert mask.all() and sols.shape == (10, 9, 9)

@@ -1,0 +1,142 @@
+"""True multi-process integration: N OS processes, real UDP + HTTP.
+
+This is the reference's own verification story executed automatically
+(SURVEY.md §4: hand-launched nodes + curl smoke tests, reference
+README.md:10-23) — launch `node.py` processes on localhost, wait for
+convergence, solve through a NON-anchor node, check /stats and /network.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_udp_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def free_tcp_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.mark.slow
+def test_three_process_cluster(readme_puzzle):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_sudoku_tpu"
+        ),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+    )
+    procs = []
+    http_ports = [free_tcp_port() for _ in range(3)]
+    udp_ports = [free_udp_port() for _ in range(3)]
+    try:
+        for k in range(3):
+            cmd = [
+                sys.executable, os.path.join(REPO, "node.py"),
+                "-p", str(http_ports[k]), "-s", str(udp_ports[k]),
+                "-h", "0", "--buckets", "1",
+            ]
+            if k > 0:
+                cmd += ["-a", f"localhost:{udp_ports[0]}"]
+            procs.append(
+                subprocess.Popen(
+                    cmd, env=env, cwd=REPO,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                )
+            )
+
+        # wait for the full topology to appear at every node's /network
+        want = {f"127.0.0.1:{p}" for p in udp_ports}
+        deadline = time.monotonic() + 90
+        converged = False
+        while time.monotonic() < deadline and not converged:
+            try:
+                views = [_get(f"http://127.0.0.1:{p}/network")[1] for p in http_ports]
+                converged = all(
+                    want
+                    == set(v.keys()) | {a for ch in v.values() for a in ch}
+                    for v in views
+                )
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert converged, "cluster did not converge"
+
+        # solve through a NON-anchor node (reference capability: any node can
+        # be master, SURVEY.md intro [verified live])
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_ports[2]}/solve",
+            data=json.dumps({"sudoku": readme_puzzle}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            solution = json.loads(resp.read())
+        assert all(0 not in row for row in solution)
+
+        # stats reach the anchor via gossip
+        deadline = time.monotonic() + 15
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            _, stats = _get(f"http://127.0.0.1:{http_ports[0]}/stats")
+            ok = stats["all"]["solved"] >= 1
+            time.sleep(0.3)
+        assert ok, stats
+
+        # SIGINT one worker: the survivors prune it from /network
+        procs[1].send_signal(signal.SIGINT)
+        deadline = time.monotonic() + 20
+        pruned = False
+        dead = f"127.0.0.1:{udp_ports[1]}"
+        while time.monotonic() < deadline and not pruned:
+            try:
+                _, view0 = _get(f"http://127.0.0.1:{http_ports[0]}/network")
+                _, view2 = _get(f"http://127.0.0.1:{http_ports[2]}/network")
+                seen = set()
+                for v in (view0, view2):
+                    seen |= set(v.keys()) | {a for ch in v.values() for a in ch}
+                pruned = dead not in seen
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert pruned, "dead peer still visible in /network"
+
+        # the 2-node cluster still solves
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_ports[0]}/solve",
+            data=json.dumps({"sudoku": readme_puzzle}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)

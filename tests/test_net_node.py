@@ -1,0 +1,246 @@
+"""Integration tests: real P2P nodes exchanging real UDP datagrams on
+localhost (the reference's own multi-node test pattern, SURVEY.md §4.3 —
+in-process threads instead of OS processes so the suite stays fast; the
+subprocess variant lives in test_integration_multiproc.py)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+import urllib.error
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+
+
+def free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1,))
+    eng.warmup()
+    return eng
+
+
+class Cluster:
+    """N in-process nodes wired like the reference README's launch recipe."""
+
+    def __init__(self, n, engine, handicap=0.0):
+        self.nodes = []
+        self.threads = []
+        anchor = None
+        for k in range(n):
+            port = free_port()
+            node = P2PNode(
+                "127.0.0.1", port, anchor_node=anchor, handicap=handicap,
+                engine=engine,
+            )
+            if anchor is None:
+                anchor = f"127.0.0.1:{port}"
+            self.nodes.append(node)
+        for node in self.nodes:
+            t = threading.Thread(target=node.run, daemon=True)
+            t.start()
+            self.threads.append(t)
+
+    def wait_converged(self, timeout=10.0):
+        """Wait until every node knows every other node."""
+        want = {n.id for n in self.nodes}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ok = True
+            for node in self.nodes:
+                known = set(node.membership.total_peers()) | {node.id}
+                if known != want:
+                    ok = False
+                    break
+            if ok:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def stop(self):
+        for node in self.nodes:
+            node.shutdown()
+        for t in self.threads:
+            t.join(timeout=2)
+
+
+def test_two_node_join_and_network_view(engine):
+    c = Cluster(2, engine)
+    try:
+        assert c.wait_converged(), [n.membership.all_peers for n in c.nodes]
+        a, b = c.nodes
+        # topology converges to {anchor: [joiner]} on both sides
+        assert c.nodes[0].network_view() == c.nodes[1].network_view()
+    finally:
+        c.stop()
+
+
+def test_four_node_convergence(engine):
+    c = Cluster(4, engine)
+    try:
+        assert c.wait_converged(), [n.membership.all_peers for n in c.nodes]
+    finally:
+        c.stop()
+
+
+def test_distributed_solve_farms_tasks(engine, readme_puzzle):
+    c = Cluster(3, engine)
+    try:
+        assert c.wait_converged()
+        master = c.nodes[0]
+        before = engine.validations
+        solution = master.peer_sudoku_solve(readme_puzzle)
+        assert solution is not None
+        assert oracle_is_valid_solution(solution)
+        root = np.asarray(readme_puzzle)
+        assert (np.asarray(solution)[root > 0] == root[root > 0]).all()
+        assert engine.validations > before
+        assert master.solved_puzzles == 1
+    finally:
+        c.stop()
+
+
+def test_solve_unsat_returns_none(engine):
+    c = Cluster(2, engine)
+    try:
+        assert c.wait_converged()
+        bad = [[0] * 9 for _ in range(9)]
+        bad[0][0] = bad[0][1] = 5
+        assert c.nodes[0].peer_sudoku_solve(bad) is None
+        # the defect fix: failures are NOT counted as solved (reference
+        # node.py:471-474 counts them)
+        assert c.nodes[0].solved_puzzles == 0
+    finally:
+        c.stop()
+
+
+def test_stats_gossip_spreads(engine, readme_puzzle):
+    c = Cluster(3, engine)
+    try:
+        assert c.wait_converged()
+        c.nodes[1].peer_sudoku_solve(readme_puzzle)
+        deadline = time.monotonic() + 5
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            stats = c.nodes[2].get_stats()  # a node that did NOT serve the solve
+            ok = stats["all"]["solved"] >= 1 and stats["all"]["validations"] > 0
+            time.sleep(0.05)
+        assert ok, c.nodes[2].get_stats()
+    finally:
+        c.stop()
+
+
+def test_disconnect_prunes_topology(engine):
+    c = Cluster(3, engine)
+    try:
+        assert c.wait_converged()
+        victim = c.nodes[2]
+        victim.shutdown()
+        deadline = time.monotonic() + 5
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            ok = all(
+                victim.id not in n.membership.total_peers()
+                for n in c.nodes[:2]
+            )
+            time.sleep(0.05)
+        assert ok, [n.membership.all_peers for n in c.nodes[:2]]
+    finally:
+        c.stop()
+
+
+def test_http_surface(engine, readme_puzzle):
+    c = Cluster(2, engine)
+    httpd = None
+    try:
+        assert c.wait_converged()
+        http_port = free_port()
+        httpd = make_http_server(c.nodes[0], "127.0.0.1", http_port)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{http_port}"
+
+        # POST /solve
+        req = urllib.request.Request(
+            f"{base}/solve",
+            data=json.dumps({"sudoku": readme_puzzle}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-type"] == "application/json"
+            solution = json.loads(resp.read())
+        assert oracle_is_valid_solution(solution)
+
+        # GET /stats — reference shape
+        with urllib.request.urlopen(f"{base}/stats", timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert set(stats.keys()) == {"all", "nodes"}
+        assert stats["all"]["solved"] >= 1
+
+        # GET /network — dict[str, list[str]]
+        with urllib.request.urlopen(f"{base}/network", timeout=10) as resp:
+            network = json.loads(resp.read())
+        assert isinstance(network, dict)
+        assert all(isinstance(v, list) for v in network.values())
+
+        # unknown endpoint → 404 {"error": "Invalid endpoint"}
+        try:
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.loads(e.read()) == {"error": "Invalid endpoint"}
+
+        # unsolvable → 400 {"error": "No solution found", "solution": null}
+        bad = [[0] * 9 for _ in range(9)]
+        bad[0][0] = bad[0][1] = 5
+        req = urllib.request.Request(
+            f"{base}/solve",
+            data=json.dumps({"sudoku": bad}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read()) == {
+                "error": "No solution found",
+                "solution": None,
+            }
+
+        # malformed body → 400 (defect fix: reference crashes the handler)
+        req = urllib.request.Request(
+            f"{base}/solve", data=b"not json",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        c.stop()
+
+
+def test_mesh_pseudo_peers(engine):
+    port = free_port()
+    node = P2PNode("127.0.0.1", port, engine=engine, mesh_peer_count=4)
+    view = node.network_view()
+    assert view == {node.id: [f"{node.id}/tpu{k}" for k in range(4)]}

@@ -1,0 +1,131 @@
+"""Unit tests for membership topology and stats gossip semantics."""
+
+from sudoku_solver_distributed_tpu.net import wire
+from sudoku_solver_distributed_tpu.net.membership import Membership
+from sudoku_solver_distributed_tpu.net.stats import StatsGossip
+
+A, B, C, D = "h:7000", "h:7001", "h:7002", "h:7003"
+
+
+def test_join_flow():
+    anchor = Membership(A)
+    joiner = Membership(B)
+    anchor.on_connect(B)        # B dialed A
+    joiner.on_connected(A)      # A acked
+    assert B in anchor.peers_out
+    assert A in joiner.peers_in
+    assert joiner.all_peers == {A: [B]}
+    assert joiner.network_view() == {A: [B]}
+    assert anchor.network_view() == {A: []}  # alone-view shape
+
+
+def test_merge_grow_only_union():
+    m = Membership(C)
+    assert m.merge_all_peers({A: [B]}) is True
+    assert m.merge_all_peers({A: [B]}) is False  # no change, no re-flood
+    assert m.merge_all_peers({A: [C]}) is True
+    assert set(m.all_peers[A]) == {B, C}
+    assert m.total_peers() == sorted({A, B})  # C excludes itself
+
+
+def test_second_link_target():
+    m = Membership(C)
+    m.on_connected(A)  # singly connected to A
+    m.merge_all_peers({A: [B, C], B: [D]})
+    target = m.second_link_target()
+    assert target == B  # first known non-neighbor parent that isn't us
+
+
+def test_disconnect_prunes_and_orphan_redials():
+    m = Membership(C)
+    m.on_connected(A)
+    m.merge_all_peers({A: [B, C]})
+    changed, redial = m.on_disconnect(A)
+    assert changed
+    assert A not in m.all_peers
+    assert m.peers_to_reconnect[A] is False
+    # A was our parent; with no other parents left we redial a sibling
+    assert redial == B
+
+
+def test_disconnect_child_removes_empty_parent():
+    m = Membership(A)
+    m.on_connect(B)
+    m.merge_all_peers({A: [B]})
+    changed, redial = m.on_disconnect(B)
+    assert changed
+    assert m.all_peers == {}
+    assert redial is None
+    assert m.network_view() == {A: []}
+
+
+def test_liveness_flag_revived_on_resight():
+    m = Membership(C)
+    m.merge_all_peers({A: [B]})
+    m.on_disconnect(B)
+    assert m.peers_to_reconnect[B] is False
+    m.merge_all_peers({A: [B]})
+    assert m.peers_to_reconnect[B] is True
+
+
+def make_gossip(node_id, counters=(0, 0)):
+    state = {"c": counters}
+    g = StatsGossip(node_id, lambda: state["c"])
+    return g, state
+
+
+def test_stats_max_merge():
+    g, state = make_gossip(A, (1, 10))
+    msg = wire.stats_msg(
+        B, 3, 25,
+        {"all": {"solved": 3, "validations": 25},
+         "nodes": [{"address": B, "validations": 25}]},
+    )
+    g.merge(msg)
+    snap = g.snapshot()
+    assert snap["all"]["solved"] == 4           # 3 (B) + 1 (A)
+    assert snap["all"]["validations"] == 35     # 25 + 10
+    by_addr = {n["address"]: n["validations"] for n in snap["nodes"]}
+    assert by_addr == {A: 10, B: 25}
+
+
+def test_stats_merge_is_monotone():
+    g, state = make_gossip(A, (0, 5))
+    stale = wire.stats_msg(
+        B, 1, 7,
+        {"all": {"solved": 1, "validations": 7},
+         "nodes": [{"address": B, "validations": 7}]},
+    )
+    fresh = wire.stats_msg(
+        B, 2, 30,
+        {"all": {"solved": 2, "validations": 30},
+         "nodes": [{"address": B, "validations": 30}]},
+    )
+    g.merge(fresh)
+    g.merge(stale)  # late/stale gossip must not regress anything
+    snap = g.snapshot()
+    by_addr = {n["address"]: n["validations"] for n in snap["nodes"]}
+    assert by_addr[B] == 30
+    assert snap["all"]["solved"] == 2
+
+
+def test_stats_third_party_view_propagates():
+    # B relays what it knows about C; A has never heard from C directly
+    g, _ = make_gossip(A, (0, 0))
+    msg = wire.stats_msg(
+        B, 0, 5,
+        {"all": {"solved": 0, "validations": 17},
+         "nodes": [{"address": B, "validations": 5},
+                   {"address": C, "validations": 12}]},
+    )
+    g.merge(msg)
+    by_addr = {n["address"]: n["validations"] for n in g.snapshot()["nodes"]}
+    assert by_addr[C] == 12
+
+
+def test_stats_shape_matches_reference():
+    g, _ = make_gossip(A, (0, 0))
+    snap = g.snapshot()
+    assert set(snap.keys()) == {"all", "nodes"}
+    assert set(snap["all"].keys()) == {"solved", "validations"}
+    assert all(set(n.keys()) == {"address", "validations"} for n in snap["nodes"])

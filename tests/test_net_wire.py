@@ -1,0 +1,90 @@
+"""Golden-wire tests: exact JSON bytes for the 7 UDP message types.
+
+The expected strings below are the byte-for-byte shapes the reference emits
+(constructors at reference node.py:199, 210, 402, 441, 563, 573, 583-592,
+652-654; README.md:69-79 protocol table).
+"""
+
+import json
+
+from sudoku_solver_distributed_tpu.net import wire
+
+
+def test_connect_bytes():
+    assert (
+        wire.encode_msg(wire.connect_msg("127.0.0.1:7001"))
+        == b'{"type": "connect", "address": "127.0.0.1:7001"}'
+    )
+
+
+def test_connected_bytes():
+    assert (
+        wire.encode_msg(wire.connected_msg("127.0.0.1:7000"))
+        == b'{"type": "connected", "address": "127.0.0.1:7000"}'
+    )
+
+
+def test_all_peers_bytes():
+    msg = wire.all_peers_msg({"127.0.0.1:7000": ["127.0.0.1:7001"]})
+    assert (
+        wire.encode_msg(msg)
+        == b'{"type": "all_peers", "all_peers": {"127.0.0.1:7000": ["127.0.0.1:7001"]}}'
+    )
+
+
+def test_disconnect_bytes():
+    assert (
+        wire.encode_msg(wire.disconnect_msg("127.0.0.1:7002"))
+        == b'{"type": "disconnect", "address": "127.0.0.1:7002"}'
+    )
+    assert (
+        wire.encode_msg(wire.disconnect_msg("127.0.0.1:7002", (4, 7)))
+        == b'{"type": "disconnect", "address": "127.0.0.1:7002", "row": 4, "col": 7}'
+    )
+
+
+def test_solve_bytes():
+    board = [[0] * 9 for _ in range(9)]
+    msg = wire.solve_msg(board, 2, 5, "127.0.0.1:7000")
+    got = wire.encode_msg(msg)
+    # field order: type, sudoku, row, col, address (reference node.py:441)
+    assert got.startswith(b'{"type": "solve", "sudoku": [[0, 0')
+    assert got.endswith(b'"row": 2, "col": 5, "address": "127.0.0.1:7000"}')
+
+
+def test_solution_bytes_col_before_row():
+    board = [[0] * 9 for _ in range(9)]
+    msg = wire.solution_msg(board, 2, 5, 7, "127.0.0.1:7001")
+    got = wire.encode_msg(msg)
+    # the reference emits "col" BEFORE "row" in solution messages (node.py:402)
+    assert got.index(b'"col"') < got.index(b'"row"')
+    assert got.endswith(b'"col": 5, "row": 2, "solution": 7, "address": "127.0.0.1:7001"}')
+
+
+def test_solution_none_is_json_null():
+    msg = wire.solution_msg([[0]], 0, 0, None, "a:1")
+    assert b'"solution": null' in wire.encode_msg(msg)
+
+
+def test_stats_bytes():
+    all_stats = {"all": {"solved": 2, "validations": 40}, "nodes": [
+        {"address": "127.0.0.1:7000", "validations": 40}
+    ]}
+    msg = wire.stats_msg("127.0.0.1:7000", 2, 40, all_stats)
+    got = wire.encode_msg(msg)
+    want = (
+        b'{"type": "stats", "origin": "127.0.0.1:7000", "solved": 2, '
+        b'"stats": {"address": "127.0.0.1:7000", "validations": 40}, '
+        b'"all_stats": {"all": {"solved": 2, "validations": 40}, '
+        b'"nodes": [{"address": "127.0.0.1:7000", "validations": 40}]}}'
+    )
+    assert got == want
+
+
+def test_roundtrip():
+    msg = wire.solve_msg([[1, 2], [3, 4]], 0, 1, "h:1")
+    assert wire.decode_msg(wire.encode_msg(msg)) == msg
+
+
+def test_parse_address():
+    assert wire.parse_address("10.0.0.2:7123") == ("10.0.0.2", 7123)

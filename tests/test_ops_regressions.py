@@ -47,3 +47,20 @@ def test_oversized_board_rejected():
         BoardSpec(box=6)
     with pytest.raises(ValueError):
         BoardSpec(box=1)
+
+
+def test_solved_at_iteration_boundary(readme_puzzle):
+    """A board completed exactly at max_iters must still report SOLVED."""
+    import jax
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    board = generate_batch(1, 20, seed=44)  # singles-solvable
+    # find the iteration count k at which it completes, then cap at exactly k
+    full = jax.jit(lambda g: solve_batch(g, SPEC_9))(jnp.asarray(board))
+    assert bool(full.solved[0])
+    k = int(full.iters)
+    capped = jax.jit(lambda g: solve_batch(g, SPEC_9, max_iters=k))(
+        jnp.asarray(board)
+    )
+    assert bool(capped.solved[0]), (k, int(capped.status[0]))

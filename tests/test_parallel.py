@@ -1,0 +1,98 @@
+"""Mesh-execution tests on the virtual 8-device CPU mesh (see conftest.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    oracle_is_valid_solution,
+    oracle_solve,
+)
+from sudoku_solver_distributed_tpu.ops import SPEC_9
+from sudoku_solver_distributed_tpu.parallel import (
+    data_sharding,
+    default_mesh,
+    frontier_solve,
+    make_sharded_solver,
+    seed_frontier,
+)
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_solver_batch():
+    mesh = default_mesh()
+    solve = make_sharded_solver(mesh)
+    boards = generate_batch(64, 50, seed=17)  # 8 per device
+    grids, solved, stats = solve(jnp.asarray(boards))
+    assert bool(np.asarray(solved).all())
+    assert int(stats["solved"]) == 64
+    assert int(stats["validations"]) > 0
+    grids = np.asarray(grids)
+    for b in range(0, 64, 7):
+        assert oracle_is_valid_solution(grids[b].tolist())
+
+
+def test_sharded_solver_input_actually_sharded():
+    mesh = default_mesh()
+    solve = make_sharded_solver(mesh)
+    boards = jax.device_put(
+        jnp.asarray(generate_batch(16, 30, seed=3)), data_sharding(mesh)
+    )
+    grids, solved, _ = solve(boards)
+    assert bool(np.asarray(solved).all())
+    # outputs stay sharded over the mesh (no implicit gather)
+    assert len(grids.sharding.device_set) == 8
+
+
+def test_seed_frontier_partitions_search_space(readme_puzzle):
+    states, early = seed_frontier(np.asarray(readme_puzzle), target=32)
+    assert early is None
+    assert len(states) >= 32
+    # every state extends the root's clues
+    root = np.asarray(readme_puzzle)
+    mask = root > 0
+    for s in states:
+        if s[0, 0] == 1 and s[0, 1] == 1:  # unsat padding
+            continue
+        assert (s[mask] == root[mask]).all()
+
+
+def test_seed_frontier_easy_board_solves_during_seeding():
+    boards = generate_batch(1, 25, seed=4)  # singles-solvable
+    states, early = seed_frontier(boards[0], target=64)
+    assert early is not None
+    assert oracle_is_valid_solution(early.tolist())
+
+
+def test_frontier_solve_readme(readme_puzzle):
+    sol, info = frontier_solve(readme_puzzle, states_per_device=16)
+    assert sol is not None
+    assert oracle_is_valid_solution(sol)
+    root = np.asarray(readme_puzzle)
+    assert (np.asarray(sol)[root > 0] == root[root > 0]).all()
+    assert info["seeded"] >= 1
+
+
+def test_frontier_solve_unsat():
+    board = np.zeros((9, 9), np.int32)
+    board[0] = [0, 0, 2, 3, 4, 5, 6, 7, 8]
+    board[1, 0] = 1
+    board[2, 1] = 1
+    assert oracle_solve(board.tolist()) is None
+    sol, _ = frontier_solve(board, states_per_device=8)
+    assert sol is None
+
+
+def test_frontier_solve_hard_16x16():
+    from sudoku_solver_distributed_tpu.ops import spec_for_size
+
+    spec16 = spec_for_size(16)
+    board = generate_batch(1, 140, size=16, seed=12)[0]
+    sol, _ = frontier_solve(board, spec=spec16, states_per_device=8)
+    assert sol is not None
+    assert oracle_is_valid_solution(sol)
