@@ -1,0 +1,63 @@
+"""Perf experiment: compaction schedule × guess-stack depth on the TPU.
+
+Times solve_batch on the cached hard-9×9 corpus under different compaction
+schedules (floor, divisor) and max_depth values. Not part of the test suite;
+run manually: python benchmarks/exp_compaction.py
+"""
+
+import itertools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
+from sudoku_solver_distributed_tpu.ops import solver as S
+
+corpus = np.load("benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+dev = jnp.asarray(corpus)
+
+
+def schedule(B, div, floor):
+    caps = [B]
+    while caps[-1] // div >= floor:
+        caps.append(caps[-1] // div)
+    return caps
+
+
+def run(caps, max_depth, reps=3):
+    def fn(g):
+        state = S.init_state(g, SPEC_9, max_depth)
+        state = S._run_compacted(state, caps, SPEC_9, 4096)
+        state = S.finalize_status(state, SPEC_9)
+        return state.grid, state.status, state.iters
+
+    f = jax.jit(fn)
+    grid, status, iters = jax.block_until_ready(f(dev))
+    assert bool((np.asarray(status) == S.SOLVED).all()), caps
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(dev))
+        times.append(time.perf_counter() - t0)
+    return min(times), int(iters)
+
+
+B = corpus.shape[0]
+results = []
+for (div, floor), depth in itertools.product(
+    [(4, 64), (2, 64), (2, 32), (2, 16), (4, 16)], [64, 32, 24]
+):
+    caps = schedule(B, div, floor)
+    t, iters = run(caps, depth)
+    pps = B / t
+    results.append((pps, div, floor, depth, t, iters))
+    print(
+        f"div={div} floor={floor:3d} depth={depth:2d} "
+        f"best={t*1000:7.1f}ms pps={pps:9.0f} iters={iters}",
+        flush=True,
+    )
+
+results.sort(reverse=True)
+print("\nbest:", results[0])

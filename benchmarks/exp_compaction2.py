@@ -1,0 +1,57 @@
+"""Focused re-measurement of top compaction configs, more repeats."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.ops import SPEC_9
+from sudoku_solver_distributed_tpu.ops import solver as S
+
+corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+dev = jnp.asarray(corpus)
+
+
+def schedule(B, div, floor):
+    caps = [B]
+    while caps[-1] // div >= floor:
+        caps.append(caps[-1] // div)
+    return caps
+
+
+def run(caps, max_depth, reps=10):
+    def fn(g):
+        state = S.init_state(g, SPEC_9, max_depth)
+        state = S._run_compacted(state, caps, SPEC_9, 4096)
+        state = S.finalize_status(state, SPEC_9)
+        return state.grid, state.status, state.iters
+
+    f = jax.jit(fn)
+    grid, status, iters = jax.block_until_ready(f(dev))
+    assert bool((np.asarray(status) == S.SOLVED).all()), caps
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(dev))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times
+
+
+B = corpus.shape[0]
+for div, floor, depth in [
+    (4, 64, 64),
+    (4, 64, 24),
+    (2, 32, 24),
+    (2, 16, 24),
+    (2, 32, 32),
+    (2, 64, 24),
+]:
+    t = run(schedule(B, div, floor), depth)
+    print(
+        f"div={div} floor={floor:3d} depth={depth:2d} "
+        f"min={t[0]*1000:7.1f}ms p50={t[len(t)//2]*1000:7.1f}ms "
+        f"max={t[-1]*1000:7.1f}ms pps={B/t[0]:9.0f}",
+        flush=True,
+    )

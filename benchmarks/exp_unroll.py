@@ -1,0 +1,79 @@
+"""Perf experiment: unroll K solver steps per while_loop iteration.
+
+The compacted tail runs hundreds of iterations on tiny (64-board) slices where
+per-iteration overhead dominates; unrolling amortizes it. Steps on finished
+boards are no-ops, so semantics are unchanged.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.ops import SPEC_9
+from sudoku_solver_distributed_tpu.ops import solver as S
+
+corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+dev = jnp.asarray(corpus)
+
+
+def run_unrolled(caps, unroll, max_depth=64, max_iters=4096, reps=8):
+    def loop(state, cap_next):
+        def cond(s):
+            running = (s.status == S.RUNNING).sum()
+            lo = cap_next if cap_next else 0
+            return (s.iters < max_iters) & (running > lo)
+
+        def body(s):
+            for _ in range(unroll):
+                s = S._step(s, SPEC_9)
+            return s
+
+        return jax.lax.while_loop(cond, body, state)
+
+    def fn(g):
+        state = S.init_state(g, SPEC_9, max_depth)
+        # replicate _run_compacted but with unrolled bodies
+        def rec(state, caps):
+            if len(caps) == 1:
+                return loop(state, 0)
+            state = loop(state, caps[1])
+            perm = jnp.argsort(
+                (~(state.status == S.RUNNING)).astype(jnp.int32), stable=True
+            )
+            inv = jnp.argsort(perm)
+            permuted = S._take_boards(state, perm)
+            sub = jax.tree.map(
+                lambda x: x[: caps[1]] if x.ndim else x, permuted
+            )
+            sub = rec(sub, caps[1:])
+            merged = S._write_boards(permuted, sub, caps[1])
+            return S._take_boards(merged, inv)
+
+        state = rec(state, caps)
+        state = S.finalize_status(state, SPEC_9)
+        return state.grid, state.status, state.iters
+
+    f = jax.jit(fn)
+    grid, status, iters = jax.block_until_ready(f(dev))
+    assert bool((np.asarray(status) == S.SOLVED).all())
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(dev))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times, int(iters)
+
+
+B = corpus.shape[0]
+caps = [4096, 1024, 256, 64]
+for unroll in [1, 2, 4, 8]:
+    t, iters = run_unrolled(caps, unroll)
+    print(
+        f"unroll={unroll} min={t[0]*1000:7.1f}ms p50={t[len(t)//2]*1000:7.1f}ms "
+        f"pps={B/t[0]:9.0f} iters={iters}",
+        flush=True,
+    )

@@ -16,6 +16,20 @@ from typing import List, Optional
 import numpy as np
 
 from .oracle import Board, count_solutions, oracle_solve
+from .. import native
+
+
+def _solve(board: Board) -> Optional[Board]:
+    """Native C++ oracle when available (bit-identical results), else Python."""
+    if native.available():
+        return native.native_solve(board)
+    return oracle_solve(board)
+
+
+def _count(board: Board, limit: int) -> int:
+    if native.available():
+        return native.native_count_solutions(board, limit=limit)
+    return count_solutions(board, limit=limit)
 
 
 def generate_board(
@@ -42,7 +56,7 @@ def generate_board(
             for j in range(box):
                 board[n + i][n + j] = nums.pop()
 
-    solved = oracle_solve(board)
+    solved = _solve(board)
     assert solved is not None, "diagonal seed must always be completable"
     board = solved
 
@@ -54,7 +68,7 @@ def generate_board(
             break
         keep = board[i][j]
         board[i][j] = 0
-        if unique and count_solutions(board, limit=2) != 1:
+        if unique and _count(board, limit=2) != 1:
             board[i][j] = keep
             continue
         removed += 1

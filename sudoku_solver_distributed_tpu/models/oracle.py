@@ -52,6 +52,8 @@ def _masks(board: Sequence[Sequence[int]], size: int, box: int):
         for j in range(size):
             v = board[i][j]
             if v:
+                if v < 0 or v > size:
+                    return None  # out-of-range clue: unsatisfiable as given
                 bit = 1 << (v - 1)
                 b = (i // box) * box + (j // box)
                 if rows[i] & bit or cols[j] & bit or boxes[b] & bit:
@@ -115,6 +117,8 @@ def oracle_solve(board: Sequence[Sequence[int]]) -> Optional[Board]:
 def count_solutions(board: Sequence[Sequence[int]], limit: int = 2) -> int:
     """Count solutions up to ``limit`` (used to certify unique-solution puzzles)."""
     size, box = _geometry(board)
+    if limit <= 0:
+        return 0
     grid = [list(r) for r in board]
     m = _masks(grid, size, box)
     if m is None:

@@ -1,0 +1,146 @@
+"""Native (C++) host runtime components, loaded via ctypes.
+
+The compute path of this framework is JAX/XLA on TPU; the *host* runtime
+around it — here, the oracle solver / solution counter that certifies
+unique-solution puzzles during corpus generation (models/generator.py) — is
+native C++ for speed. The reference is pure Python with no native code
+(SURVEY.md §2), so this is an extension, not a parity obligation; everything
+degrades gracefully to the pure-Python oracle when no C++ toolchain exists.
+
+Build model: ``oracle.cc`` is compiled on first use with whatever C++
+compiler is on PATH (g++/clang++/cc) into ``_build/liboracle-<hash>.so``
+keyed by a source hash, so edits rebuild automatically and the build is a
+no-op afterwards. No pybind11 / setuptools involvement — the ABI is five
+plain C functions bound with ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "oracle.cc"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _compiler() -> Optional[str]:
+    for cc in ("g++", "clang++", "c++"):
+        path = shutil.which(cc)
+        if path:
+            return path
+    return None
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _HERE / "_build" / f"liboracle-{tag}.so"
+    if not out.exists():
+        cc = _compiler()
+        if cc is None:
+            logger.info("no C++ compiler on PATH; native oracle disabled")
+            return None
+        out.parent.mkdir(exist_ok=True)
+        tmp = out.with_suffix(f".tmp{os.getpid()}")
+        cmd = [cc, "-O2", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True, timeout=120
+            )
+        except (subprocess.SubprocessError, OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning("native oracle build failed: %s", detail)
+            return None
+        os.replace(tmp, out)  # atomic: concurrent builders race harmlessly
+    try:
+        lib = ctypes.CDLL(str(out))
+    except OSError as e:
+        # e.g. a cached .so built on another platform (the cache key is
+        # source-only); degrade to the Python oracle rather than crash.
+        logger.warning("native oracle load failed: %s", e)
+        return None
+    lib.ss_solve.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+    ]
+    lib.ss_solve.restype = ctypes.c_int
+    lib.ss_count.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+        ctypes.c_longlong,
+    ]
+    lib.ss_count.restype = ctypes.c_longlong
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is None and not _lib_failed:
+            _lib = _build()
+            _lib_failed = _lib is None
+    return _lib
+
+
+def available() -> bool:
+    """True iff the native library is (or can be) loaded."""
+    return _get_lib() is not None
+
+
+def _as_c_board(board: Sequence[Sequence[int]]) -> tuple:
+    arr = np.ascontiguousarray(board, dtype=np.int32)
+    if arr.ndim != 2 or arr.shape[0] != arr.shape[1]:
+        raise ValueError("board must be square")
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def native_solve(board: Sequence[Sequence[int]]) -> Optional[List[List[int]]]:
+    """Solved copy of ``board`` or None if unsatisfiable.
+
+    Bit-for-bit the same result as models.oracle.oracle_solve (same MRV
+    tie-breaking, same candidate order); raises RuntimeError if the native
+    library is unavailable — callers decide their own fallback.
+    """
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native oracle unavailable")
+    arr, ptr = _as_c_board(board)
+    size = arr.shape[0]
+    out = np.zeros_like(arr)
+    rc = lib.ss_solve(ptr, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), size)
+    if rc < 0:
+        raise ValueError(f"bad board geometry: {size}×{size}")
+    return out.tolist() if rc == 1 else None
+
+
+def native_count_solutions(board: Sequence[Sequence[int]], limit: int = 2) -> int:
+    """Number of solutions of ``board``, saturated at ``limit``."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native oracle unavailable")
+    arr, ptr = _as_c_board(board)
+    rc = lib.ss_count(ptr, arr.shape[0], limit)
+    if rc < 0:
+        raise ValueError(f"bad board geometry: {arr.shape[0]}×{arr.shape[0]}")
+    return int(rc)
+
+
+__all__ = ["available", "native_solve", "native_count_solutions"]

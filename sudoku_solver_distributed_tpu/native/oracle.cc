@@ -1,0 +1,149 @@
+// Native host oracle: bitmask MRV backtracking solver / solution counter.
+//
+// C++ twin of the pure-Python oracle (models/oracle.py) with byte-identical
+// deterministic behavior: cells are chosen by a row-major scan taking the
+// first strictly-smaller candidate count (early exit at 1), and candidate
+// values are tried lowest-set-bit first. Because the tie-breaking matches,
+// `ss_solve` returns the exact same solution grid as `oracle_solve`, which
+// lets the test suite assert native ≡ Python ≡ TPU-kernel agreement.
+//
+// The reference has no native code at all (SURVEY.md §2); this exists because
+// the framework's corpus generator certifies unique-solution puzzles with a
+// solution-count probe per blanked cell (models/generator.py), and that host
+// loop is worth real native speed (~100× over CPython on 9×9 counting).
+//
+// Board sizes: N×N for N in {4, 9, 16, 25} (box edge 2..5). Candidate sets are
+// uint32 bitmasks; values are 1..N, 0 = empty.
+
+#include <cstdint>
+
+namespace {
+
+constexpr int kMaxN = 25;
+
+struct Ctx {
+  int size = 0;
+  int box = 0;
+  uint32_t full = 0;
+  uint32_t rows[kMaxN];
+  uint32_t cols[kMaxN];
+  uint32_t boxes[kMaxN];
+  int32_t grid[kMaxN][kMaxN];
+  long long found = 0;
+  long long limit = 0;
+};
+
+inline int box_of(const Ctx& c, int i, int j) {
+  return (i / c.box) * c.box + (j / c.box);
+}
+
+// Load a board into ctx; returns false on a direct clue conflict (duplicate
+// value in a unit) or an out-of-range value — unsatisfiable as given.
+bool load(Ctx& c, const int32_t* board, int size, int box) {
+  c.size = size;
+  c.box = box;
+  c.full = (size == 32) ? 0xffffffffu : ((1u << size) - 1u);
+  for (int u = 0; u < size; ++u) c.rows[u] = c.cols[u] = c.boxes[u] = 0;
+  for (int i = 0; i < size; ++i) {
+    for (int j = 0; j < size; ++j) {
+      int32_t v = board[i * size + j];
+      c.grid[i][j] = v;
+      if (v == 0) continue;
+      if (v < 0 || v > size) return false;
+      uint32_t bit = 1u << (v - 1);
+      int b = box_of(c, i, j);
+      if ((c.rows[i] & bit) || (c.cols[j] & bit) || (c.boxes[b] & bit))
+        return false;
+      c.rows[i] |= bit;
+      c.cols[j] |= bit;
+      c.boxes[b] |= bit;
+    }
+  }
+  return true;
+}
+
+// MRV backtracking step. Returns true when the search should stop (for
+// solving: a solution was found; for counting: the limit was reached).
+bool step(Ctx& c) {
+  int bi = -1, bj = -1, bn = c.size + 1;
+  uint32_t bcand = 0;
+  for (int i = 0; i < c.size && bn > 1; ++i) {
+    for (int j = 0; j < c.size; ++j) {
+      if (c.grid[i][j]) continue;
+      uint32_t cand =
+          c.full & ~(c.rows[i] | c.cols[j] | c.boxes[box_of(c, i, j)]);
+      int n = __builtin_popcount(cand);
+      if (n == 0) return false;
+      if (n < bn) {
+        bi = i;
+        bj = j;
+        bn = n;
+        bcand = cand;
+        if (n == 1) break;
+      }
+    }
+  }
+  if (bi < 0) {  // complete
+    ++c.found;
+    return c.found >= c.limit;
+  }
+  int b = box_of(c, bi, bj);
+  uint32_t cand = bcand;
+  while (cand) {
+    uint32_t bit = cand & (~cand + 1u);
+    cand &= ~bit;
+    c.grid[bi][bj] = __builtin_ctz(bit) + 1;
+    c.rows[bi] |= bit;
+    c.cols[bj] |= bit;
+    c.boxes[b] |= bit;
+    bool done = step(c);
+    if (done && c.limit == 1) return true;  // solving: keep the filled grid
+    c.grid[bi][bj] = 0;
+    c.rows[bi] &= ~bit;
+    c.cols[bj] &= ~bit;
+    c.boxes[b] &= ~bit;
+    if (done) return true;
+  }
+  return false;
+}
+
+int geometry_box(int size) {
+  for (int b = 2; b <= 5; ++b)
+    if (b * b == size) return b;
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Solve `board` (size*size int32, row-major). On success writes the solved
+// grid to `out` and returns 1; returns 0 if unsatisfiable, -1 on bad geometry.
+int ss_solve(const int32_t* board, int32_t* out, int size) {
+  int box = geometry_box(size);
+  if (box < 0) return -1;
+  static thread_local Ctx c;
+  if (!load(c, board, size, box)) return 0;
+  c.found = 0;
+  c.limit = 1;
+  if (!step(c)) return 0;
+  for (int i = 0; i < size; ++i)
+    for (int j = 0; j < size; ++j) out[i * size + j] = c.grid[i][j];
+  return 1;
+}
+
+// Count solutions of `board`, stopping at `limit`. Returns the count
+// (saturated at limit), or -1 on bad geometry.
+long long ss_count(const int32_t* board, int size, long long limit) {
+  int box = geometry_box(size);
+  if (box < 0) return -1;
+  if (limit <= 0) return 0;
+  static thread_local Ctx c;
+  if (!load(c, board, size, box)) return 0;
+  c.found = 0;
+  c.limit = limit;
+  step(c);
+  return c.found;
+}
+
+}  // extern "C"

@@ -249,12 +249,8 @@ def _run_compacted(
     next_cap = caps[1]
 
     def cond(s: _State):
-        running = running_of(s)
-        return (
-            running.any()
-            & (s.iters < max_iters)
-            & (running.sum() > next_cap)
-        )
+        # running.sum() > next_cap (≥ 64) subsumes running.any()
+        return (s.iters < max_iters) & (running_of(s).sum() > next_cap)
 
     state = jax.lax.while_loop(cond, lambda s: _step(s, spec), state)
 
@@ -262,7 +258,9 @@ def _run_compacted(
     perm = jnp.argsort((~running_of(state)).astype(jnp.int32), stable=True)
     inv = jnp.argsort(perm)
     permuted = _take_boards(state, perm)
-    sub = _take_boards(permuted, jnp.arange(next_cap))
+    sub = jax.tree.map(
+        lambda x: x[:next_cap] if x.ndim else x, permuted
+    )
     sub = _run_compacted(sub, caps[1:], spec, max_iters)
     merged = _write_boards(permuted, sub, next_cap)
     return _take_boards(merged, inv)

@@ -1,0 +1,99 @@
+"""Native C++ oracle: agreement with the pure-Python oracle.
+
+The native solver (native/oracle.cc) is required to be *bit-identical* to
+models/oracle.py — same MRV tie-breaking, same candidate order — so the
+generator produces the same seeded corpora whichever backend certifies
+uniqueness. These tests pin that contract.
+"""
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu import native
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    generate_board,
+)
+from sudoku_solver_distributed_tpu.models.oracle import (
+    count_solutions,
+    oracle_is_valid_solution,
+    oracle_solve,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain in this environment"
+)
+
+
+def test_native_solve_matches_python_exactly():
+    boards = generate_batch(16, 48, seed=7)
+    for board in boards.tolist():
+        assert native.native_solve(board) == oracle_solve(board)
+
+
+def test_native_solve_solves_and_validates():
+    board = generate_board(55, rng=None)
+    sol = native.native_solve(board)
+    assert sol is not None
+    assert oracle_is_valid_solution(sol)
+    # clues preserved
+    for i in range(9):
+        for j in range(9):
+            if board[i][j]:
+                assert sol[i][j] == board[i][j]
+
+
+def test_native_unsat_and_conflict():
+    # direct clue conflict: two 1s in a row
+    bad = [[0] * 9 for _ in range(9)]
+    bad[0][0] = bad[0][1] = 1
+    assert native.native_solve(bad) is None
+    assert native.native_count_solutions(bad) == 0
+    # out-of-range value: both backends must reject (a clue of 10 can never
+    # be part of a 9×9 solution)
+    bad2 = [[0] * 9 for _ in range(9)]
+    bad2[3][3] = 10
+    assert native.native_solve(bad2) is None
+    assert oracle_solve(bad2) is None
+    assert native.native_count_solutions(bad2) == count_solutions(bad2) == 0
+
+
+def test_count_limit_zero_parity():
+    empty = [[0] * 9 for _ in range(9)]
+    assert native.native_count_solutions(empty, limit=0) == 0
+    assert count_solutions(empty, limit=0) == 0
+
+
+def test_native_count_matches_python():
+    boards = generate_batch(8, 40, seed=11)
+    for board in boards.tolist():
+        for limit in (1, 2, 5):
+            assert native.native_count_solutions(board, limit) == count_solutions(
+                board, limit=limit
+            )
+
+
+def test_native_count_empty_board_saturates():
+    empty = [[0] * 9 for _ in range(9)]
+    assert native.native_count_solutions(empty, limit=3) == 3
+
+
+def test_native_sizes_4_and_16():
+    b4 = [[0] * 4 for _ in range(4)]
+    sol = native.native_solve(b4)
+    assert sol is not None and oracle_is_valid_solution(sol)
+    b16 = generate_board(60, size=16, rng=None)
+    sol16 = native.native_solve(b16)
+    assert sol16 is not None and oracle_is_valid_solution(sol16)
+
+
+def test_bad_geometry_raises():
+    with pytest.raises(ValueError):
+        native.native_solve([[0] * 5 for _ in range(5)])
+
+
+def test_generator_unique_certification_native():
+    """generate_board(unique=True) must go through the native counter and
+    still emit a puzzle with exactly one solution."""
+    board = generate_board(50, unique=True, rng=None)
+    assert count_solutions(board, limit=2) == 1
