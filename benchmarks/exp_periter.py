@@ -1,0 +1,59 @@
+"""Measure per-iteration solver cost vs batch size, and iters per level."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.ops import SPEC_9
+from sudoku_solver_distributed_tpu.ops import solver as S
+
+corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+
+# fixed-iteration run: cost per iteration at batch B
+for B in [64, 256, 1024, 4096]:
+    boards = jnp.asarray(corpus[:B])
+
+    def fn(g, iters):
+        st = S.init_state(g, SPEC_9, 64)
+
+        def cond(s):
+            return s.iters < iters
+
+        return jax.lax.while_loop(cond, lambda s: S._step(s, SPEC_9), st).grid
+
+    f = jax.jit(fn, static_argnums=1)
+    jax.block_until_ready(f(boards, 10))
+    jax.block_until_ready(f(boards, 210))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(boards, 10))
+        t1 = time.perf_counter()
+        jax.block_until_ready(f(boards, 210))
+        t2 = time.perf_counter()
+        ts.append((t2 - t1) - (t1 - t0))  # 200 extra iters, launch cost cancelled
+    per_iter = min(ts) / 200
+    print(f"B={B:5d}  per-iter={per_iter*1e6:8.1f}us", flush=True)
+
+# iteration count per compaction level (how deep is the tail?)
+dev = jnp.asarray(corpus)
+
+
+def levels(g):
+    st = S.init_state(g, SPEC_9, 64)
+    marks = []
+    for cap in [1024, 256, 64, 0]:
+        def cond(s, cap=cap):
+            return ((s.status == S.RUNNING).sum() > cap) & (s.iters < 4096)
+
+        st = jax.lax.while_loop(cond, lambda s: S._step(s, SPEC_9), st)
+        marks.append(st.iters)
+        perm = jnp.argsort((~(st.status == S.RUNNING)).astype(jnp.int32), stable=True)
+        st = S._take_boards(st, perm)  # keep full size; just reorder
+    return tuple(marks)
+
+
+marks = jax.jit(levels)(dev)
+print("iters at level boundaries (1024/256/64/done):", [int(m) for m in marks])

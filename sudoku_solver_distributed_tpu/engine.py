@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ops import BoardSpec, SPEC_9, solve_batch
+from .utils.profiling import annotate, device_trace
 
 
 DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
@@ -52,6 +53,12 @@ class SolverEngine:
         self.buckets = tuple(sorted(set(buckets)))
         self.max_depth = max_depth
         self.sharding = sharding
+        # when set, batch device calls are captured as jax.profiler traces
+        # under this directory (utils/profiling.py; CLI --profile-dir); only
+        # one trace can be active per process, so concurrent requests skip
+        # tracing instead of crashing (_profile_mutex)
+        self.profile_dir: Optional[str] = None
+        self._profile_mutex = threading.Lock()
         self._lock = threading.Lock()
         # cumulative engine effort, the analog of the reference's
         # `validations` counter (node.py:87): one unit per analysis sweep per
@@ -103,7 +110,18 @@ class SolverEngine:
         if n < bucket:
             pad = np.zeros((bucket - n, *boards.shape[1:]), boards.dtype)
             boards = np.concatenate([boards, pad], axis=0)
-        packed = self._solve(self._device_batch(boards))
+        if self.profile_dir is not None and self._profile_mutex.acquire(
+            blocking=False
+        ):
+            try:
+                with device_trace(self.profile_dir), annotate(
+                    f"solve_bucket_{bucket}"
+                ):
+                    packed = self._solve(self._device_batch(boards))
+            finally:
+                self._profile_mutex.release()
+        else:
+            packed = self._solve(self._device_batch(boards))
         return np.asarray(packed)[:n]
 
     # -- public API --------------------------------------------------------

@@ -16,6 +16,18 @@ Extensions (defaults preserve reference behavior):
   --mesh-peers  N: surface N TPU-core pseudo-peers at /network (the
                 north-star mapping, BASELINE.json); default 0
   --no-warmup   skip engine pre-compilation (faster start, slower first solve)
+  --metrics     expose GET /metrics (per-route latency percentiles); off by
+                default so the unknown-path 404 surface stays byte-identical
+  --profile-dir write a jax.profiler device trace of each /solve to this dir
+  --failure-timeout
+                seconds of neighbor silence before a crash is declared (the
+                gossip heartbeat); 0 restores the reference's graceful-only
+                failure model
+  --coordinator / --num-hosts / --host-id
+                multi-host mode: initialize jax.distributed against the
+                coordinator ("host:port") so the engine's mesh spans a pod
+                slice; the P2P/HTTP control plane is unchanged (SURVEY.md §5
+                distributed-backend row)
 """
 
 from __future__ import annotations
@@ -48,6 +60,25 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="comma-separated engine batch buckets (default 1,8,64,512,4096)",
     )
+    parser.add_argument(
+        "--metrics", action="store_true", help="expose GET /metrics"
+    )
+    parser.add_argument(
+        "--profile-dir", default=None, help="jax.profiler trace output dir"
+    )
+    parser.add_argument(
+        "--failure-timeout",
+        type=float,
+        default=5.0,
+        help="declare a silent neighbor dead after this many seconds (0=off)",
+    )
+    parser.add_argument(
+        "--coordinator",
+        default=None,
+        help="jax.distributed coordinator host:port (multi-host pod slice)",
+    )
+    parser.add_argument("--num-hosts", type=int, default=1)
+    parser.add_argument("--host-id", type=int, default=0)
     return parser
 
 
@@ -60,6 +91,17 @@ def main(argv=None) -> None:
         level=logging.INFO, format="%(asctime)s - %(levelname)s - %(message)s"
     )
 
+    if args.coordinator:
+        # Pod-slice mode: every host runs this same CLI; XLA collectives ride
+        # ICI/DCN underneath while the UDP/HTTP control plane stays host-side.
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_hosts,
+            process_id=args.host_id,
+        )
+
     engine = None
     if args.buckets:
         from ..engine import SolverEngine
@@ -67,6 +109,8 @@ def main(argv=None) -> None:
         engine = SolverEngine(
             buckets=tuple(int(b) for b in args.buckets.split(","))
         )
+    from ..utils.profiling import RequestMetrics
+
     node = P2PNode(
         args.host,
         args.s,
@@ -74,13 +118,19 @@ def main(argv=None) -> None:
         handicap=args.h / 100,
         engine=engine,
         mesh_peer_count=args.mesh_peers,
+        failure_timeout=args.failure_timeout,
+        metrics=RequestMetrics(),
     )
+    if args.profile_dir:
+        node.engine.profile_dir = args.profile_dir
     if not args.no_warmup:
         # pre-compile the serving buckets so the first /solve is warm
         # (p50 <5 ms contract, engine.SolverEngine.warmup)
         threading.Thread(target=node.engine.warmup, daemon=True).start()
 
-    httpd = make_http_server(node, args.host, args.p)
+    httpd = make_http_server(
+        node, args.host, args.p, expose_metrics=args.metrics
+    )
     http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     http_thread.start()
     try:

@@ -25,7 +25,9 @@ logger = logging.getLogger(__name__)
 
 
 class SudokuHTTPHandler(BaseHTTPRequestHandler):
-    p2p_node = None  # set by make_http_server
+    p2p_node = None       # set by make_http_server
+    expose_metrics = False  # opt-in /metrics route (CLI --metrics); default
+    #                         off keeps the 404 surface byte-identical
 
     def _send_response(self, content, status: int = 200) -> None:
         body = json.dumps(content).encode()
@@ -34,7 +36,13 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _record(self, route: str, t0: float, error: bool = False) -> None:
+        m = getattr(self.p2p_node, "metrics", None)
+        if m is not None:
+            m.record(route, time.perf_counter() - t0, error=error)
+
     def do_POST(self):
+        t0 = time.perf_counter()
         if self.path == "/solve":
             initial_time = time.time()
             logger.info("received /solve POST request")
@@ -44,15 +52,18 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
                 sudoku = json.loads(post_data.decode("utf-8"))["sudoku"]
             except (ValueError, KeyError, UnicodeDecodeError):
                 self._send_response({"error": "Invalid request"}, 400)
+                self._record("/solve", t0, error=True)
                 return
             solution = self.p2p_node.peer_sudoku_solve(sudoku)
             logger.info("execution time: %s", time.time() - initial_time)
             if solution:
                 self._send_response(solution)
+                self._record("/solve", t0)
             else:
                 self._send_response(
                     {"error": "No solution found", "solution": solution}, 400
                 )
+                self._record("/solve", t0, error=True)
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)
 
@@ -61,6 +72,9 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             self._send_response(self.p2p_node.get_stats())
         elif self.path == "/network":
             self._send_response(self.p2p_node.network_view())
+        elif self.path == "/metrics" and self.expose_metrics:
+            m = getattr(self.p2p_node, "metrics", None)
+            self._send_response(m.summary() if m is not None else {})
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)
 
@@ -68,8 +82,14 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
         logger.debug("%s - %s", self.address_string(), fmt % args)
 
 
-def make_http_server(p2p_node, host: str, http_port: int) -> ThreadingHTTPServer:
-    handler = type("BoundHandler", (SudokuHTTPHandler,), {"p2p_node": p2p_node})
+def make_http_server(
+    p2p_node, host: str, http_port: int, *, expose_metrics: bool = False
+) -> ThreadingHTTPServer:
+    handler = type(
+        "BoundHandler",
+        (SudokuHTTPHandler,),
+        {"p2p_node": p2p_node, "expose_metrics": expose_metrics},
+    )
     httpd = ThreadingHTTPServer((host, http_port), handler)
     logger.info("HTTP server on %s:%s", host, http_port)
     return httpd

@@ -38,6 +38,7 @@ logger = logging.getLogger(__name__)
 TASK_DEADLINE_S = 5.0       # reassign a dispatched cell after this long
 SOLVE_WAIT_SLICE_S = 0.05   # condition-wait granularity in the dispatch loop
 GOSSIP_INTERVAL_S = 1.0     # periodic stats broadcast (see P2PNode.run)
+FAILURE_TIMEOUT_S = 5.0     # declare a silent neighbor dead after this long
 
 
 class P2PNode:
@@ -49,6 +50,8 @@ class P2PNode:
         handicap: float = 0.001,
         engine: Optional[SolverEngine] = None,
         mesh_peer_count: int = 0,
+        failure_timeout: float = FAILURE_TIMEOUT_S,
+        metrics=None,
     ):
         self.host = host
         self.port = port
@@ -83,6 +86,21 @@ class P2PNode:
         self.mesh_peer_ids: List[str] = [
             f"{self.id}/tpu{k}" for k in range(mesh_peer_count)
         ]
+
+        # Crash-failure detector. The reference detects departures only via
+        # the graceful `disconnect` message — a SIGKILL'd peer stays in every
+        # view forever (SURVEY.md §3.5 [verified live]). The 1 Hz stats gossip
+        # doubles as a heartbeat: any datagram from a neighbor refreshes
+        # `_last_seen`; a neighbor silent past `failure_timeout` is treated
+        # exactly as if it had sent `disconnect` (prune + re-flood + requeue),
+        # reusing the existing wire surface. 0 disables (pure reference
+        # semantics).
+        self.failure_timeout = failure_timeout
+        self._last_seen: Dict[str, float] = {}
+        self._last_tick = time.monotonic()
+        # request-latency recorder fed by the HTTP layer (utils/profiling.py);
+        # optional so bare nodes pay nothing
+        self.metrics = metrics
 
     # -- counters ----------------------------------------------------------
     # `solved` counts one per successful master solve (reference node.py:468
@@ -147,6 +165,14 @@ class P2PNode:
     # -- message dispatch ---------------------------------------------------
     def handle_message(self, msg: wire.Msg) -> None:
         mtype = msg.get("type")
+        # Heartbeat refresh, keyed by the peer's *self-reported* id — the same
+        # key membership.neighbors() holds. (Keying by UDP source address
+        # breaks when a peer binds e.g. "localhost" but datagrams arrive from
+        # "127.0.0.1": the watched key would never refresh and a healthy
+        # neighbor would be declared dead forever.)
+        sender = msg.get("address") or msg.get("origin")
+        if isinstance(sender, str):
+            self._last_seen[sender] = time.monotonic()
         if mtype == "connect":
             self.membership.on_connect(msg["address"])
             self.send_to(msg["address"], wire.connected_msg(self.id))
@@ -278,8 +304,13 @@ class P2PNode:
                         del self.active_tasks[peer]
                         self.task_queue.appendleft((row, col))
 
-                # dispatch one cell per idle peer (reference node.py:433-442)
-                live = set(self.membership.total_peers()) or set(peers)
+                # dispatch one cell per idle peer (reference node.py:433-442).
+                # Membership is re-read each round so departures (graceful or
+                # detected crashes) shrink the pool mid-solve.
+                live = set(self.membership.total_peers())
+                all_workers_gone = not live and (
+                    self.task_queue or self.active_tasks
+                )
                 for peer in sorted(live):
                     if not self.task_queue:
                         break
@@ -310,10 +341,12 @@ class P2PNode:
                 if not done:
                     self._solution_event.wait(timeout=SOLVE_WAIT_SLICE_S)
 
-            if requeued_none:
-                # a worker proved its (possibly mixed-merge) board unsat: fall
-                # back to the authoritative engine on the original request —
-                # replaces the reference's swap-repair (node.py:487-532)
+            if requeued_none or all_workers_gone:
+                # Fall back to the authoritative engine on the original
+                # request when (a) a worker proved its (possibly mixed-merge)
+                # board unsat — replaces the reference's swap-repair
+                # (node.py:487-532) — or (b) every worker departed mid-solve
+                # (the reference would dispatch to dead peers forever).
                 solution, _ = self.engine.solve_one(sudoku)
                 return solution
 
@@ -377,6 +410,7 @@ class P2PNode:
                 ):
                     self.connect_to_anchor_node()
                     last_anchor_try = time.monotonic()
+                self._reap_dead_neighbors()
                 payload, _ = self.recv()
                 if payload is None:
                     continue
@@ -385,6 +419,37 @@ class P2PNode:
                 self.shutdown()
             except Exception as e:  # a malformed datagram must not kill the node
                 logger.error("error handling datagram: %s", e)
+
+    def _reap_dead_neighbors(self) -> None:
+        """Declare neighbors silent past the failure timeout dead.
+
+        Detection is the periodic gossip's absence; the response path is the
+        same as a received ``disconnect`` (prune, re-flood the deletion,
+        requeue any in-flight assignment), so crash recovery and graceful
+        departure are one code path.
+        """
+        if not self.failure_timeout:
+            return
+        now = time.monotonic()
+        # Stall grace: if this loop itself was blocked (engine compile, a
+        # long inline task, GC) past the heartbeat cadence, neighbors' gossip
+        # sat unread in the socket buffer and every timestamp is stale through
+        # no fault of the peers. Give everyone a fresh window instead of
+        # mass-declaring the whole membership dead.
+        if now - self._last_tick > min(1.0, self.failure_timeout / 2):
+            for peer in list(self._last_seen):
+                self._last_seen[peer] = now
+        self._last_tick = now
+        for peer in self.membership.neighbors():
+            seen = self._last_seen.setdefault(peer, now)  # grace on first sight
+            if now - seen > self.failure_timeout:
+                logger.warning(
+                    "peer %s silent for %.1fs — declaring it failed",
+                    peer,
+                    now - seen,
+                )
+                self._last_seen.pop(peer, None)
+                self._on_disconnect(wire.disconnect_msg(peer))
 
     def shutdown(self) -> None:
         """Graceful departure (reference node.py:646-658)."""

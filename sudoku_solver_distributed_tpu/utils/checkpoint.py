@@ -1,0 +1,149 @@
+"""Checkpoint / resume for long-running solves.
+
+The reference has none: a killed solve loses everything; partial progress
+lives only in process RAM (SURVEY.md §5, reference node.py:148-149 — `pickle`
+is imported and never used, reference node.py:11). Here the DFS solver's
+entire search state — grids, guess stacks, depths, statuses, counters — is an
+explicit JAX pytree (ops/solver._State), so checkpointing is exact: a restored
+solve continues bit-for-bit where it left off, including the iteration budget
+already spent.
+
+``solve_batch_resumable`` is the host driver: it runs the jitted lockstep
+loop in bounded chunks and writes an atomic .npz snapshot between chunks; on
+restart with the same path it resumes from the snapshot instead of the
+original boards. The snapshot is a plain compressed npz (format-versioned,
+geometry-tagged) — no orbax dependency for a few MB of int arrays, and the
+file is inspectable with numpy alone.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..ops import BoardSpec, spec_for_size
+from ..ops import solver as S
+
+_FORMAT = 1
+_FIELDS = (
+    "grid",
+    "stack_grid",
+    "stack_cell",
+    "stack_mask",
+    "depth",
+    "status",
+    "guesses",
+    "validations",
+    "iters",
+)
+
+
+def save_solver_state(path: str, state: S._State, spec: BoardSpec) -> None:
+    """Atomically snapshot a solver state pytree to ``path`` (.npz)."""
+    arrays = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
+    arrays["__format__"] = np.int64(_FORMAT)
+    arrays["__box__"] = np.int64(spec.box)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)  # atomic publish: no torn snapshots on crash
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_solver_state(path: str) -> Tuple[S._State, BoardSpec]:
+    """Restore a snapshot written by ``save_solver_state``."""
+    with np.load(path) as z:
+        if int(z["__format__"]) != _FORMAT:
+            raise ValueError(
+                f"unsupported checkpoint format {int(z['__format__'])}"
+            )
+        spec = BoardSpec(box=int(z["__box__"]))
+        state = S._State(**{f: z[f] for f in _FIELDS})
+    C = spec.cells
+    if state.grid.ndim != 2 or state.grid.shape[1] != C:
+        raise ValueError(
+            f"checkpoint grid shape {state.grid.shape} does not match "
+            f"{spec.size}×{spec.size} boards"
+        )
+    return jax.tree.map(lambda x: jax.numpy.asarray(x), state), spec
+
+
+@partial(jax.jit, static_argnames=("spec", "chunk", "max_iters"))
+def _run_chunk(state: S._State, spec: BoardSpec, chunk: int, max_iters: int):
+    """Advance every RUNNING board by ≤``chunk`` lockstep iterations."""
+    target = jax.numpy.minimum(state.iters + chunk, max_iters)
+
+    def cond(s):
+        return ((s.status == S.RUNNING).any()) & (s.iters < target)
+
+    return jax.lax.while_loop(cond, lambda s: S.step(s, spec), state)
+
+
+def solve_batch_resumable(
+    grid,
+    spec: Optional[BoardSpec] = None,
+    *,
+    checkpoint_path: str,
+    chunk_iters: int = 256,
+    max_iters: int = 65536,
+    max_depth: Optional[int] = None,
+    keep_checkpoint: bool = False,
+) -> S.SolveResult:
+    """Solve a batch with periodic checkpoints; resume if one exists.
+
+    Semantics match ops.solver.solve_batch (without compaction — chunk
+    boundaries replace it as the long-tail control point). The checkpoint is
+    deleted on completion unless ``keep_checkpoint``.
+    """
+    grid = np.asarray(grid, np.int32)
+    if spec is None:
+        spec = spec_for_size(grid.shape[-1])
+
+    if os.path.exists(checkpoint_path):
+        state, ck_spec = load_solver_state(checkpoint_path)
+        if ck_spec != spec:
+            raise ValueError(
+                f"checkpoint at {checkpoint_path} is for a "
+                f"{ck_spec.size}×{ck_spec.size} solve, not {spec.size}×{spec.size}"
+            )
+        if state.grid.shape[0] != grid.shape[0]:
+            raise ValueError(
+                f"checkpoint batch {state.grid.shape[0]} != request batch "
+                f"{grid.shape[0]}"
+            )
+    else:
+        state = S.init_state(jax.numpy.asarray(grid), spec, max_depth)
+
+    while True:
+        state = jax.block_until_ready(
+            _run_chunk(state, spec, chunk_iters, max_iters)
+        )
+        done = not bool(np.asarray(state.status == S.RUNNING).any())
+        if done or int(state.iters) >= max_iters:
+            break
+        save_solver_state(checkpoint_path, state, spec)
+
+    state = S.finalize_status(state, spec)
+    if not keep_checkpoint and os.path.exists(checkpoint_path):
+        os.unlink(checkpoint_path)
+
+    B, N = grid.shape[0], spec.size
+    return S.SolveResult(
+        grid=state.grid.reshape(B, N, N),
+        solved=state.status == S.SOLVED,
+        status=state.status,
+        guesses=state.guesses,
+        validations=state.validations,
+        iters=state.iters,
+    )

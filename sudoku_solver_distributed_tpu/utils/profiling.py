@@ -1,0 +1,94 @@
+"""Tracing & request metrics — the observability the reference lacks.
+
+The reference's only timing instrumentation is one wall-clock log line per
+HTTP solve (reference node.py:674, 681-683) and two gossip counters
+(SURVEY.md §5). This module adds the TPU-framework equivalents without
+touching the byte-identical HTTP/UDP surfaces:
+
+  * ``RequestMetrics`` — thread-safe per-route latency recorder (ring buffer)
+    with count / p50 / p95 / p99 / max summaries, fed by the HTTP layer and
+    surfaced on the opt-in ``/metrics`` endpoint (gated behind a CLI flag;
+    with the flag off, unknown paths 404 exactly like the reference).
+  * ``device_trace`` — context manager around ``jax.profiler.trace``: dumps
+    an XLA/TPU trace viewable in TensorBoard/Perfetto for any code region
+    (the serving path wires it to a ``--profile-dir`` CLI flag).
+  * ``annotate`` — ``jax.profiler.TraceAnnotation`` passthrough so engine
+    phases (warmup, bucket solve, frontier race) show up as named spans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import deque
+from typing import Dict, Iterator, Optional
+
+
+class RequestMetrics:
+    """Per-route latency ring buffer with percentile summaries."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self._window = window
+        self._lat: Dict[str, deque] = {}
+        self._count: Dict[str, int] = {}
+        self._errors: Dict[str, int] = {}
+
+    def record(self, route: str, seconds: float, error: bool = False) -> None:
+        with self._lock:
+            if route not in self._lat:
+                self._lat[route] = deque(maxlen=self._window)
+                self._count[route] = 0
+                self._errors[route] = 0
+            self._lat[route].append(seconds)
+            self._count[route] += 1
+            if error:
+                self._errors[route] += 1
+
+    @staticmethod
+    def _pct(sorted_vals, q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[idx]
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """{route: {count, errors, p50_ms, p95_ms, p99_ms, max_ms}}."""
+        with self._lock:
+            out: Dict[str, Dict[str, float]] = {}
+            for route, window in self._lat.items():
+                vals = sorted(window)
+                out[route] = {
+                    "count": self._count[route],
+                    "errors": self._errors[route],
+                    "p50_ms": round(self._pct(vals, 0.50) * 1e3, 3),
+                    "p95_ms": round(self._pct(vals, 0.95) * 1e3, 3),
+                    "p99_ms": round(self._pct(vals, 0.99) * 1e3, 3),
+                    "max_ms": round((max(vals) if vals else 0.0) * 1e3, 3),
+                }
+            return out
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler device trace into ``log_dir`` (no-op if None).
+
+    The dump is the standard XProf format: point TensorBoard's profile plugin
+    (or xprof) at the directory. Keep regions short — traces are verbose.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span in any active device trace (host+device timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

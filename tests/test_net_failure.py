@@ -1,0 +1,170 @@
+"""Crash-failure detection: the failure mode the reference cannot see.
+
+The reference detects departures only via the graceful ``disconnect`` message;
+a SIGKILL'd peer stays in every /network and /stats view forever (SURVEY.md
+§3.5 [verified live]). Here the 1 Hz stats gossip doubles as a heartbeat and a
+silent neighbor is pruned after ``failure_timeout`` through the exact same
+code path as a graceful disconnect.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.utils.profiling import RequestMetrics
+
+
+def free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1,))
+    eng.warmup()
+    return eng
+
+
+def make_cluster(n, engine, failure_timeout):
+    nodes, threads = [], []
+    anchor = None
+    for _ in range(n):
+        port = free_port()
+        node = P2PNode(
+            "127.0.0.1",
+            port,
+            anchor_node=anchor,
+            handicap=0.0,
+            engine=engine,
+            failure_timeout=failure_timeout,
+            metrics=RequestMetrics(),
+        )
+        if anchor is None:
+            anchor = f"127.0.0.1:{port}"
+        nodes.append(node)
+    for node in nodes:
+        t = threading.Thread(target=node.run, daemon=True)
+        t.start()
+        threads.append(t)
+    return nodes, threads
+
+
+def wait_converged(nodes, timeout=10.0):
+    want = {n.id for n in nodes}
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(
+            set(n.membership.total_peers()) | {n.id} == want for n in nodes
+        ):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def crash(node):
+    """SIGKILL-equivalent: stop the loop with no disconnect message."""
+    node.shutdown_flag = True
+    node.sock.close()
+
+
+def test_crashed_peer_is_pruned(engine):
+    nodes, _ = make_cluster(3, engine, failure_timeout=2.0)
+    try:
+        assert wait_converged(nodes), [n.membership.all_peers for n in nodes]
+        victim = nodes[2]
+        crash(victim)
+        deadline = time.monotonic() + 10
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            ok = all(
+                victim.id not in n.membership.total_peers() for n in nodes[:2]
+            )
+            time.sleep(0.05)
+        assert ok, [n.membership.all_peers for n in nodes[:2]]
+    finally:
+        for n in nodes:
+            if not n.shutdown_flag:
+                n.shutdown()
+
+
+def test_failure_detector_off_keeps_reference_semantics(engine):
+    """failure_timeout=0 restores the reference's graceful-only model: the
+    crashed peer is never pruned (that is the reference's verified-live
+    behavior, SURVEY.md §3.5)."""
+    nodes, _ = make_cluster(2, engine, failure_timeout=0.0)
+    try:
+        assert wait_converged(nodes)
+        crash(nodes[1])
+        time.sleep(3.0)
+        assert nodes[1].id in nodes[0].membership.total_peers()
+    finally:
+        for n in nodes:
+            if not n.shutdown_flag:
+                n.shutdown()
+
+
+def test_solve_completes_despite_crashed_worker(engine):
+    """A farmed solve must survive a worker crashing mid-flight: the task
+    deadline requeues its cell and the request still completes correctly."""
+    nodes, _ = make_cluster(2, engine, failure_timeout=1.5)
+    try:
+        assert wait_converged(nodes)
+        master, worker = nodes
+        crash(worker)  # dies before the solve even starts
+        board = [[0] * 9 for _ in range(9)]
+        board[0][0] = 1
+        solution = master.peer_sudoku_solve(board)
+        assert solution is not None and solution[0][0] == 1
+    finally:
+        for n in nodes:
+            if not n.shutdown_flag:
+                n.shutdown()
+
+
+def test_metrics_endpoint_opt_in(engine):
+    nodes, _ = make_cluster(1, engine, failure_timeout=0.0)
+    node = nodes[0]
+    on = make_http_server(node, "127.0.0.1", 0, expose_metrics=True)
+    off = make_http_server(node, "127.0.0.1", 0, expose_metrics=False)
+    for httpd in (on, off):
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base_on = f"http://127.0.0.1:{on.server_address[1]}"
+        base_off = f"http://127.0.0.1:{off.server_address[1]}"
+
+        # default surface: /metrics is an invalid endpoint, like the reference
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base_off}/metrics", timeout=5)
+        assert exc.value.code == 404
+        assert json.load(exc.value) == {"error": "Invalid endpoint"}
+
+        # opt-in: empty until a request is recorded, then percentiles appear
+        with urllib.request.urlopen(f"{base_on}/metrics", timeout=5) as r:
+            assert json.load(r) == {}
+        req = urllib.request.Request(
+            f"{base_on}/solve",
+            data=json.dumps({"sudoku": [[0] * 9 for _ in range(9)]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(f"{base_on}/metrics", timeout=5) as r:
+            m = json.load(r)
+        assert m["/solve"]["count"] == 1
+        assert m["/solve"]["p50_ms"] > 0
+    finally:
+        on.shutdown()
+        off.shutdown()
+        node.shutdown()
