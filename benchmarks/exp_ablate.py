@@ -1,0 +1,121 @@
+"""Ablate analyze() components to find kernel-level wins at large batch.
+
+Variants (monkeypatched into the solver step):
+  base      — current analyze (naked + hidden singles, int32 one-hots)
+  int8      — one-hot tensors in int8 (less HBM traffic if materialized)
+  naked     — no hidden-singles pass (cheaper sweep, more iterations)
+  hid-row   — hidden singles from row totals only (middle ground)
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.ops import SPEC_9
+from sudoku_solver_distributed_tpu.ops import solver as S
+from sudoku_solver_distributed_tpu.ops.encode import (
+    _counts_to_mask,
+    box_index,
+    mask_to_value,
+)
+from sudoku_solver_distributed_tpu.ops.propagate import Analysis
+
+corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+MULT = 4
+big = jnp.asarray(np.tile(corpus, (MULT, 1, 1)))
+B_TOTAL = big.shape[0]
+
+
+def make_analyze(onehot_dtype=jnp.int32, hidden="full"):
+    def analyze(grid, spec):
+        n, N = spec.box, spec.size
+        B = grid.shape[0]
+        onehot = (
+            grid[..., None] == jnp.arange(1, N + 1, dtype=grid.dtype)
+        ).astype(onehot_dtype)
+        rows = onehot.sum(axis=2)
+        cols = onehot.sum(axis=1)
+        boxes = onehot.reshape(B, n, n, n, n, N).sum(axis=(2, 4)).reshape(B, N, N)
+        dup = (
+            (rows > 1).any(axis=(1, 2))
+            | (cols > 1).any(axis=(1, 2))
+            | (boxes > 1).any(axis=(1, 2))
+        )
+        solved = (
+            (rows == 1).all(axis=(1, 2))
+            & (cols == 1).all(axis=(1, 2))
+            & (boxes == 1).all(axis=(1, 2))
+        )
+        shifts = jnp.arange(N, dtype=jnp.int32)
+        row_used = _counts_to_mask(rows, spec)
+        col_used = _counts_to_mask(cols, spec)
+        box_used = _counts_to_mask(boxes, spec)
+        bidx = box_index(spec)
+        used = row_used[:, :, None] | col_used[:, None, :] | box_used[:, bidx]
+        empty = grid == 0
+        cand = jnp.where(empty, ~used & jnp.int32(spec.full_mask), jnp.int32(0))
+
+        if hidden == "none":
+            hidden_mask = jnp.zeros_like(cand)
+        else:
+            conehot = (
+                jnp.right_shift(cand[..., None], shifts) & 1
+            ).astype(onehot_dtype)
+            row_tot = conehot.sum(axis=2)
+            if hidden == "full":
+                col_tot = conehot.sum(axis=1)
+                box_tot = (
+                    conehot.reshape(B, n, n, n, n, N)
+                    .sum(axis=(2, 4))
+                    .reshape(B, N, N)
+                )
+                hid = conehot & (
+                    (row_tot[:, :, None, :] == 1)
+                    | (col_tot[:, None, :, :] == 1)
+                    | (box_tot[:, bidx, :] == 1)
+                ).astype(onehot_dtype)
+            else:  # row-only
+                hid = conehot & (row_tot[:, :, None, :] == 1).astype(onehot_dtype)
+            hidden_mask = jnp.left_shift(hid.astype(jnp.int32), shifts).sum(-1)
+
+        naked = jax.lax.population_count(cand) == 1
+        assign = jnp.where(naked, cand, hidden_mask)
+        assign = assign & -assign
+        dead = (empty & (cand == 0)).any(axis=(1, 2))
+        bad = ((grid < 0) | (grid > N)).any(axis=(1, 2))
+        return Analysis(cand, assign, dup | dead | bad, solved)
+
+    return analyze
+
+
+def bench(name, analyze_fn, reps=4):
+    orig = S.analyze
+    S.analyze = analyze_fn
+    try:
+        f = jax.jit(
+            lambda g: (
+                lambda r: (r.solved, r.iters)
+            )(S.solve_batch(g, SPEC_9, max_depth=64, max_iters=8192))
+        )
+        out = jax.block_until_ready(f(big))
+        assert bool(np.asarray(out[0]).all()), name
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(big))
+            ts.append(time.perf_counter() - t0)
+        print(
+            f"{name:8s} best={min(ts)*1000:7.1f}ms pps={B_TOTAL/min(ts):9.0f} "
+            f"iters={int(out[1])}",
+            flush=True,
+        )
+    finally:
+        S.analyze = orig
+
+
+bench("base", make_analyze())
+bench("int8", make_analyze(onehot_dtype=jnp.int8))
+bench("naked", make_analyze(hidden="none"))
+bench("hid-row", make_analyze(hidden="row"))

@@ -108,7 +108,7 @@ def test_solver_mixed_batch(readme_puzzle):
     assert np.asarray(res.status).tolist() == [SOLVED, UNSAT, SOLVED]
 
 
-@pytest.mark.parametrize("size,holes", [(16, 80)])
+@pytest.mark.parametrize("size,holes", [(16, 80), (25, 150)])
 def test_solver_16x16(size, holes):
     spec = spec_for_size(size)
     boards = generate_batch(2, holes, size=size, seed=5)
