@@ -18,7 +18,6 @@ from sudoku_solver_distributed_tpu.ops import solver as S
 from sudoku_solver_distributed_tpu.ops.encode import (
     _counts_to_mask,
     box_index,
-    mask_to_value,
 )
 from sudoku_solver_distributed_tpu.ops.propagate import Analysis
 

@@ -21,6 +21,7 @@ engine-authoritative fallback instead of the lossy swap-repair heuristic
 from __future__ import annotations
 
 import logging
+import queue
 import socket
 import threading
 import time
@@ -77,9 +78,20 @@ class P2PNode:
         self.active_tasks: Dict[str, Tuple[int, int, float]] = {}
         self.solution_queue: deque = deque()
 
-        # worker-side: the task currently being computed (for the disconnect
-        # message's row/col fields, reference node.py:651-654)
+        # worker-side: dispatched cells are solved on a dedicated thread so
+        # the UDP loop keeps handling gossip (and so keeps *sending* the
+        # heartbeat) while the engine works — an inline solve that compiles
+        # can block for tens of seconds, which the reference tolerates (its
+        # loop has no liveness duty, reference node.py:384-406) but a
+        # heartbeat-bearing loop cannot: peers would false-positive the busy
+        # node as crashed. `_current_task` is the cell being computed, for
+        # the disconnect message's row/col fields (reference node.py:651-654).
         self._current_task: Optional[Tuple[int, int]] = None
+        self._worker_tasks: "queue.Queue" = queue.Queue()
+        self._worker_thread = threading.Thread(
+            target=self._worker_loop, daemon=True
+        )
+        self._worker_thread.start()
 
         # TPU pseudo-peers surfaced at /network when enabled (north-star
         # mapping: each reported peer ≙ one TPU core, BASELINE.json)
@@ -210,12 +222,6 @@ class P2PNode:
 
     def _on_disconnect(self, msg: wire.Msg) -> None:
         address = msg["address"]
-        # a departing peer hands back its in-flight cell (reference
-        # node.py:335-337)
-        if "row" in msg and "col" in msg:
-            with self._state_lock:
-                self.task_queue.appendleft((msg["row"], msg["col"]))
-                self._solution_event.notify_all()
         changed, redial = self.membership.on_disconnect(address)
         if changed:
             if self.membership.all_peers:
@@ -233,16 +239,42 @@ class P2PNode:
                     self.send_to(peer, wire.disconnect_msg(address))
         if redial is not None:
             self.send_to(redial, wire.connect_msg(self.id))
+        # Requeue whatever WE had assigned to the departed peer — our
+        # active_tasks map is the ground truth. The wire message's optional
+        # row/col (reference node.py:651-654, still sent on our shutdown for
+        # reference interop) is deliberately ignored on receive: with the
+        # departure flooded to all neighbors, that cell belongs to whichever
+        # master assigned it, and every other master trusting it would
+        # poison its own queue with a foreign cell while dropping its own.
         with self._state_lock:
             if address in self.active_tasks:
-                # its assignment is gone; requeue unless the disconnect already did
                 row, col, _ = self.active_tasks.pop(address)
-                if "row" not in msg:
-                    self.task_queue.appendleft((row, col))
+                self.task_queue.appendleft((row, col))
                 self._solution_event.notify_all()
 
     # -- worker side -------------------------------------------------------
     def _on_solve_task(self, msg: wire.Msg) -> None:
+        """Enqueue a dispatched cell for the worker thread (FIFO)."""
+        self._worker_tasks.put((time.monotonic(), msg))
+
+    def _worker_loop(self) -> None:
+        while not self.shutdown_flag:
+            try:
+                enqueued, msg = self._worker_tasks.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            # Staleness shedding: past the master's reassignment deadline the
+            # cell has been requeued and answered by someone else — a slow
+            # start (first-compile) would otherwise grind through a backlog
+            # of duplicate full-board solves.
+            if time.monotonic() - enqueued > TASK_DEADLINE_S:
+                continue
+            try:
+                self._solve_task(msg)
+            except Exception as e:  # a bad task must not kill the worker
+                logger.error("worker task failed: %s", e)
+
+    def _solve_task(self, msg: wire.Msg) -> None:
         """Answer one cell of a dispatched board (reference node.py:384-406).
 
         The reference worker probes greedily for the first non-conflicting

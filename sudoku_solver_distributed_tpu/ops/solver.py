@@ -54,6 +54,21 @@ class _State(NamedTuple):
     iters: jnp.ndarray       # () int32
 
 
+def _mrv_cell(grid: jnp.ndarray, cand: jnp.ndarray):
+    """Minimum-remaining-values branching cell per board.
+
+    Args: flattened (B, C) grid and candidate masks. Returns (cell, mask):
+    the flat index of each board's emptiest-candidate empty cell and that
+    cell's candidate bitmask. Shared by the DFS step and the tail widener so
+    both branch on the same cell by construction.
+    """
+    pc = jax.lax.population_count(cand)
+    pc_key = jnp.where(grid == 0, pc, jnp.int32(jnp.iinfo(jnp.int32).max))
+    cell = jnp.argmin(pc_key, axis=1).astype(jnp.int32)
+    b = jnp.arange(grid.shape[0])
+    return cell, cand[b, cell]
+
+
 def _step(state: _State, spec: BoardSpec) -> _State:
     B, C = state.grid.shape
     D = state.stack_mask.shape[1]
@@ -80,10 +95,7 @@ def _step(state: _State, spec: BoardSpec) -> _State:
 
     # --- path 2: branch (no contradiction, no singles) — MRV cell
     do_branch = act & ~contra & ~has_single
-    pc = jax.lax.population_count(cand)
-    pc_key = jnp.where(state.grid == 0, pc, jnp.int32(jnp.iinfo(jnp.int32).max))
-    mrv_cell = jnp.argmin(pc_key, axis=1).astype(jnp.int32)  # (B,)
-    mrv_mask = cand[b, mrv_cell]
+    mrv_cell, mrv_mask = _mrv_cell(state.grid, cand)
     guess_bit = mrv_mask & -mrv_mask
     overflow = do_branch & (state.depth >= D)
     do_branch = do_branch & (state.depth < D)
@@ -223,8 +235,123 @@ def _write_boards(state: _State, sub: _State, count: int) -> _State:
     )
 
 
+def _run_widened(state: _State, spec: BoardSpec, max_iters: int) -> _State:
+    """Race the pathological tail: restart each still-RUNNING board from its
+    search root and explore all top-level candidates of its MRV cell as
+    parallel children.
+
+    The lockstep DFS serializes candidate retries at every depth; for the few
+    hardest boards of a batch that serial depth — not batch cost — dominates
+    wall time (measured: ~450 of ~540 total iterations spent on the last ≤64
+    boards). Widening trades FLOPs for depth, the same exchange
+    parallel/frontier.py makes across chips, but inside one jit: each parent's
+    root (its depth-0 stack snapshot — the propagated grid before its first
+    guess) is split into N children, child v fixing the root's MRV cell to
+    value v (a dead child if v isn't a candidate). Children partition the
+    parent's solution space exactly, so: any child SOLVED ⇒ parent solved
+    with that grid; all children UNSAT ⇒ parent unsatisfiable; children
+    still RUNNING at the iteration cap ⇒ parent stays RUNNING. Discarding
+    the parent's partial DFS progress re-explores at most what a wrong first
+    guess had already wasted; the N-way parallel restart wins it back.
+    """
+    R, C = state.grid.shape
+    D = state.stack_mask.shape[1]
+    N = spec.size
+    r = jnp.arange(R)
+
+    # A board can arrive with a completed grid but status still RUNNING (the
+    # grace loop's last _step evaluates solved-ness pre-assignment); flip it
+    # here or the restart below would discard its solution.
+    state = finalize_status(state, spec)
+    running = state.status == RUNNING
+    root = jnp.where(
+        (state.depth > 0)[:, None],
+        state.stack_grid[:, 0].astype(jnp.int32),
+        state.grid,
+    )
+
+    a = analyze(root.reshape(R, N, N), spec)
+    cand = a.cand.reshape(R, C)
+    cell, cmask = _mrv_cell(root, cand)                       # (R,), (R,)
+
+    values = jnp.arange(1, N + 1, dtype=jnp.int32)            # (N,)
+    valid = (cmask[:, None] >> (values - 1)[None, :]) & 1     # (R, N)
+    child_grid = jnp.broadcast_to(root[:, None, :], (R, N, C))
+    child_grid = child_grid.at[
+        r[:, None], jnp.arange(N)[None, :], cell[:, None]
+    ].set(values[None, :])
+    # non-running parents pass through: children carry the parent's grid and
+    # terminal status so extraction below is uniform
+    child_grid = jnp.where(
+        running[:, None, None], child_grid, state.grid[:, None, :]
+    )
+    child_status = jnp.where(
+        running[:, None],
+        jnp.where(valid == 1, RUNNING, UNSAT),
+        state.status[:, None],
+    )
+
+    w = init_state(child_grid.reshape(R * N, N, N), spec, D)
+    w = w._replace(status=child_status.reshape(R * N), iters=state.iters)
+
+    def parents_done(ws):
+        st = ws.status.reshape(R, N)
+        return ((st == SOLVED).any(axis=1)) | (~(st == RUNNING).any(axis=1))
+
+    def cond(ws):
+        return (~parents_done(ws)).any() & (ws.iters < max_iters)
+
+    w = jax.lax.while_loop(cond, lambda ws: _step(ws, spec), w)
+    w = finalize_status(w, spec)
+
+    st = w.status.reshape(R, N)
+    solved_any = (st == SOLVED).any(axis=1)
+    unsat_all = (st == UNSAT).all(axis=1)
+    overflow_any = (st == OVERFLOW).any(axis=1)
+    win = jnp.argmax(st == SOLVED, axis=1)                    # (R,)
+    won_grid = w.grid.reshape(R, N, C)[r, win]
+
+    new_status = jnp.where(
+        solved_any,
+        SOLVED,
+        jnp.where(
+            unsat_all,
+            UNSAT,
+            jnp.where(overflow_any & ~(st == RUNNING).any(axis=1),
+                      OVERFLOW, RUNNING),
+        ),
+    )
+    # a RUNNING parent whose root is itself already a solution (possible when
+    # the grace loop hit its iteration cap the same step a board completed)
+    # must short-circuit to SOLVED — its "children" all refute the forced
+    # cell-0 overwrite and would otherwise read as UNSAT
+    new_status = jnp.where(a.solved & running, SOLVED, new_status)
+    won_grid = jnp.where((a.solved & running)[:, None], root, won_grid)
+    # pass-through parents keep their original terminal status/grid
+    new_status = jnp.where(running, new_status, state.status)
+    new_grid = jnp.where(running[:, None], won_grid, state.grid)
+
+    wg = w.guesses.reshape(R, N).sum(axis=1)
+    wv = w.validations.reshape(R, N).sum(axis=1)
+    return state._replace(
+        grid=new_grid,
+        status=new_status,
+        # widening itself is an N-way speculative branch; children's work
+        # folds into the parent's counters (the accounting contract: effort
+        # actually spent on this board)
+        guesses=state.guesses + jnp.where(running, wg + 1, 0),
+        validations=state.validations + jnp.where(running, wv, 0),
+        depth=jnp.where(running, 0, state.depth),
+        iters=w.iters,
+    )
+
+
 def _run_compacted(
-    state: _State, caps: list, spec: BoardSpec, max_iters: int
+    state: _State,
+    caps: list,
+    spec: BoardSpec,
+    max_iters: int,
+    widen_after: int | None = None,
 ) -> _State:
     """Run the lockstep loop with hierarchical active-board compaction.
 
@@ -237,6 +364,10 @@ def _run_compacted(
     1/4, 1/16, ... of the batch cost. Static shapes throughout: ``caps`` is a
     Python list fixed at trace time, so the whole schedule compiles into one
     jitted graph.
+
+    At the final level, boards still RUNNING after ``widen_after`` further
+    iterations are handed to ``_run_widened`` — the serial-depth-bound
+    pathological tail races all top-level candidates in parallel instead.
     """
     running_of = lambda s: s.status == RUNNING  # noqa: E731
 
@@ -244,7 +375,23 @@ def _run_compacted(
         def cond(s: _State):
             return running_of(s).any() & (s.iters < max_iters)
 
-        return jax.lax.while_loop(cond, lambda s: _step(s, spec), state)
+        if widen_after is None:
+            return jax.lax.while_loop(cond, lambda s: _step(s, spec), state)
+
+        grace_end = jnp.minimum(state.iters + widen_after, max_iters)
+
+        def grace_cond(s: _State):
+            return running_of(s).any() & (s.iters < grace_end)
+
+        state = jax.lax.while_loop(
+            grace_cond, lambda s: _step(s, spec), state
+        )
+        return jax.lax.cond(
+            running_of(state).any(),
+            lambda s: _run_widened(s, spec, max_iters),
+            lambda s: s,
+            state,
+        )
 
     next_cap = caps[1]
 
@@ -261,7 +408,7 @@ def _run_compacted(
     sub = jax.tree.map(
         lambda x: x[:next_cap] if x.ndim else x, permuted
     )
-    sub = _run_compacted(sub, caps[1:], spec, max_iters)
+    sub = _run_compacted(sub, caps[1:], spec, max_iters, widen_after)
     merged = _write_boards(permuted, sub, next_cap)
     return _take_boards(merged, inv)
 
@@ -281,6 +428,7 @@ def solve_batch(
     max_iters: int = 4096,
     max_depth: int | None = None,
     compact: bool = True,
+    widen_after: int | None = None,
 ) -> SolveResult:
     """Solve a batch of boards to completion (or proven unsatisfiability).
 
@@ -293,6 +441,16 @@ def solve_batch(
         ``_run_compacted``); semantically identical, far faster on large
         batches whose hardest boards need many more iterations than the
         median. Disable to force the single flat while_loop.
+      widen_after: at the last compaction level, boards still unresolved
+        after this many further iterations restart as N parallel top-level
+        children (``_run_widened``) — the serial-depth escape hatch for
+        adversarial boards. None (default) disables: on the ordinary hard-9×9
+        bench corpus the restart costs more than it saves (measured 2026-07:
+        52k vs 100k puzzles/s/chip), because those tails are not
+        top-level-retry bound; enable for boards engineered against MRV
+        ordering. The widened batch is (last level size)×N children, so with
+        ``compact=False`` the *whole batch* would widen ×N; to keep memory
+        bounded the option is ignored when that product exceeds 8192 boards.
 
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """
@@ -300,7 +458,9 @@ def solve_batch(
     state = init_state(grid, spec, max_depth)
 
     caps = _compaction_schedule(B) if compact else [B]
-    state = _run_compacted(state, caps, spec, max_iters)
+    if widen_after is not None and caps[-1] * spec.size > 8192:
+        widen_after = None  # see docstring: bound the widened batch's memory
+    state = _run_compacted(state, caps, spec, max_iters, widen_after)
     state = finalize_status(state, spec)
 
     N = spec.size

@@ -51,6 +51,12 @@ def test_three_process_cluster(readme_puzzle):
         ),
         JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
     )
+    # This environment's sitecustomize registers the axon (tunneled TPU)
+    # backend whenever PALLAS_AXON_POOL_IPS is set, overriding
+    # JAX_PLATFORMS=cpu — and three processes contending for the single
+    # tunneled chip deadlock on compiles. Drop the trigger so the children
+    # really run on CPU.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     procs = []
     http_ports = [free_tcp_port() for _ in range(3)]
     udp_ports = [free_udp_port() for _ in range(3)]

@@ -121,6 +121,29 @@ def test_solver_16x16(size, holes):
         assert (grids[b][mask] == boards[b][mask]).all()
 
 
+def test_tail_widening_equivalent():
+    """widen_after restarts unresolved boards as N parallel children; results
+    must match the pure-DFS path exactly (unique-solution corpus)."""
+    boards = generate_batch(32, 64, seed=21, unique=True)
+    ref = _solve(boards, widen_after=None)
+    wid = _solve(boards, widen_after=1)  # force widening on
+    assert bool(ref.solved.all()) and bool(wid.solved.all())
+    np.testing.assert_array_equal(np.asarray(ref.grid), np.asarray(wid.grid))
+
+
+def test_tail_widening_unsat_and_terminal_passthrough():
+    bad = np.zeros((3, 9, 9), np.int32)
+    bad[0, 0, 0] = bad[0, 0, 1] = 7        # clue conflict → UNSAT
+    bad[1] = generate_batch(1, 60, seed=22)[0]  # solvable
+    # widen_after=3: the clue conflict goes terminal during the grace loop,
+    # exercising _run_widened's pass-through branch for finished boards,
+    # while harder boards still widen
+    res = _solve(bad, widen_after=3)
+    assert np.asarray(res.status).tolist()[0] == UNSAT
+    assert bool(np.asarray(res.solved)[1])
+    assert bool(np.asarray(res.solved)[2])  # empty board
+
+
 def test_validations_counted():
     boards = generate_batch(4, 40, seed=2)
     res = _solve(boards)
