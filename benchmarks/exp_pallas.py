@@ -1,0 +1,49 @@
+"""Pallas VMEM-resident kernel vs the XLA compacted solver, on real TPU.
+
+Run (needs the tunneled chip): PYTHONPATH=/root/repo:$PYTHONPATH python
+benchmarks/exp_pallas.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
+from sudoku_solver_distributed_tpu.ops.pallas_solver import solve_batch_pallas
+
+boards = np.load("/root/repo/benchmarks/corpus_9x9_hard_16384.npz")["boards"]
+dev = jnp.asarray(boards)
+B = dev.shape[0]
+
+
+def sustained(f, reps=5):
+    out = jax.block_until_ready(f(dev))
+    t0 = time.perf_counter()
+    outs = [f(dev) for _ in range(reps)]
+    jax.block_until_ready(outs[-1])
+    return (time.perf_counter() - t0) / reps, out
+
+
+f_xla = jax.jit(lambda g: solve_batch(g, SPEC_9, max_depth=64).status)
+t, st = sustained(f_xla)
+assert bool((np.asarray(st) == 1).all())
+print(f"xla          sustained={t*1000:7.1f}ms pps={B/t:9.0f}", flush=True)
+
+for block in (128, 256, 512):
+    f_p = jax.jit(
+        lambda g, block=block: solve_batch_pallas(
+            g, SPEC_9, block=block, max_depth=64
+        ).status
+    )
+    try:
+        t, st = sustained(f_p)
+        ok = bool((np.asarray(st) == 1).all())
+        print(
+            f"pallas b={block:4d} sustained={t*1000:7.1f}ms pps={B/t:9.0f} "
+            f"all_solved={ok}",
+            flush=True,
+        )
+    except Exception as e:
+        print(f"pallas b={block}: FAIL {type(e).__name__}: {str(e)[:200]}")

@@ -61,6 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated engine batch buckets (default 1,8,64,512,4096)",
     )
     parser.add_argument(
+        "--board-size",
+        type=int,
+        default=9,
+        choices=[4, 9, 16, 25],
+        help="board edge length the engine serves (9, 16 hexadoku, or 25)",
+    )
+    parser.add_argument(
         "--metrics", action="store_true", help="expose GET /metrics"
     )
     parser.add_argument(
@@ -102,13 +109,13 @@ def main(argv=None) -> None:
             process_id=args.host_id,
         )
 
-    engine = None
-    if args.buckets:
-        from ..engine import SolverEngine
+    from ..engine import SolverEngine
+    from ..ops import spec_for_size
 
-        engine = SolverEngine(
-            buckets=tuple(int(b) for b in args.buckets.split(","))
-        )
+    kwargs = {"spec": spec_for_size(args.board_size)}
+    if args.buckets:
+        kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
+    engine = SolverEngine(**kwargs)
     from ..utils.profiling import RequestMetrics
 
     node = P2PNode(

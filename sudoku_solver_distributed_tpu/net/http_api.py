@@ -51,19 +51,21 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
                 post_data = self.rfile.read(content_length)
                 sudoku = json.loads(post_data.decode("utf-8"))["sudoku"]
             except (ValueError, KeyError, UnicodeDecodeError):
-                self._send_response({"error": "Invalid request"}, 400)
+                # record before replying: a client may poll /metrics the
+                # instant its response arrives
                 self._record("/solve", t0, error=True)
+                self._send_response({"error": "Invalid request"}, 400)
                 return
             solution = self.p2p_node.peer_sudoku_solve(sudoku)
             logger.info("execution time: %s", time.time() - initial_time)
             if solution:
-                self._send_response(solution)
                 self._record("/solve", t0)
+                self._send_response(solution)
             else:
+                self._record("/solve", t0, error=True)
                 self._send_response(
                     {"error": "No solution found", "solution": solution}, 400
                 )
-                self._record("/solve", t0, error=True)
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)
 

@@ -273,6 +273,19 @@ class P2PNode:
                 self._solve_task(msg)
             except Exception as e:  # a bad task must not kill the worker
                 logger.error("worker task failed: %s", e)
+                # Reply value=None anyway: the master's engine-authoritative
+                # fallback then takes over. Silence would make it requeue the
+                # cell every deadline forever (e.g. a board-size mismatch
+                # between nodes fails deterministically on every retry).
+                try:
+                    self.send_to(
+                        msg["address"],
+                        wire.solution_msg(
+                            msg["sudoku"], msg["row"], msg["col"], None, self.id
+                        ),
+                    )
+                except Exception:
+                    pass
 
     def _solve_task(self, msg: wire.Msg) -> None:
         """Answer one cell of a dispatched board (reference node.py:384-406).

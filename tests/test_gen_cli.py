@@ -1,0 +1,31 @@
+"""The generator CLI contract (reference gen.py:55-66): ``python gen.py N``
+prints the rendered board (zeros highlighted) followed by a ready-made curl
+command embedding the puzzle."""
+
+import ast
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_gen_cli_prints_board_and_curl():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep the child off the TPU tunnel
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "gen.py"), "30"],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    # curl line embeds the grid as a Python/JSON list (reference gen.py:63-66)
+    m = re.search(r"curl .*/solve.*'\{\"sudoku\": (\[\[.*\]\])\}'", out.stdout)
+    assert m, out.stdout[-2000:]
+    grid = ast.literal_eval(m.group(1))
+    assert len(grid) == 9 and all(len(r) == 9 for r in grid)
+    assert sum(1 for row in grid for v in row if v == 0) == 30

@@ -1,0 +1,43 @@
+"""Hexadoku (16×16) through the full serving stack — the scale-out config
+the reference hardwires away (SURVEY.md §5: board size is 9 everywhere in
+the reference; here it's a CLI flag, --board-size)."""
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    oracle_is_valid_solution,
+)
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.ops import spec_for_size
+
+
+@pytest.fixture(scope="module")
+def engine16():
+    eng = SolverEngine(spec_for_size(16), buckets=(1,))
+    eng.warmup()
+    return eng
+
+
+def test_engine_solves_hexadoku(engine16):
+    board = generate_batch(1, 120, size=16, seed=61)[0]
+    solution, info = engine16.solve_one(board.tolist())
+    assert solution is not None
+    assert oracle_is_valid_solution(solution)
+    mask = board > 0
+    assert (np.asarray(solution)[mask] == board[mask]).all()
+    assert info["validations"] >= 1
+
+
+def test_node_serves_hexadoku(engine16):
+    node = P2PNode("127.0.0.1", 0, engine=engine16, failure_timeout=0.0)
+    board = generate_batch(1, 100, size=16, seed=62)[0]
+    solution = node.peer_sudoku_solve(board.tolist())
+    assert solution is not None and oracle_is_valid_solution(solution)
+    assert node.solved_puzzles == 1
+
+    unsat = [[0] * 16 for _ in range(16)]
+    unsat[0][0] = unsat[0][1] = 9
+    assert node.peer_sudoku_solve(unsat) is None
