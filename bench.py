@@ -20,14 +20,27 @@ import os
 import sys
 import time
 
-BENCH_BATCH = int(os.environ.get("BENCH_BATCH", "16384"))
+# BENCH_SIZE selects the board config: 9 (headline, the north-star corpus),
+# 16 hexadoku, or 25. Per-size stretch targets normalize vs_baseline (9×9 is
+# BASELINE.json's ≥100k/chip; larger boards scaled as rough cell-count-cubed
+# stretch goals — no reference numbers exist at any size, BASELINE.md).
+BENCH_SIZE = int(os.environ.get("BENCH_SIZE", "9"))
+_DEFAULT_BATCH = {9: 16384, 16: 2048, 25: 512}
+if BENCH_SIZE not in _DEFAULT_BATCH:
+    sys.exit(f"BENCH_SIZE must be one of {sorted(_DEFAULT_BATCH)}, got {BENCH_SIZE}")
+BENCH_BATCH = int(
+    os.environ.get("BENCH_BATCH", str(_DEFAULT_BATCH[BENCH_SIZE]))
+)
 BENCH_REPEATS = int(os.environ.get("BENCH_REPEATS", "5"))
+_HOLES = {9: 64, 16: 140, 25: 320}
+# iteration budget grows with board area (4096 is the 9×9-tuned safety net)
+_MAX_ITERS = {9: 4096, 16: 16384, 25: 65536}
 CORPUS_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "benchmarks",
-    f"corpus_9x9_hard_{BENCH_BATCH}.npz",
+    f"corpus_{BENCH_SIZE}x{BENCH_SIZE}_hard_{BENCH_BATCH}.npz",
 )
-TARGET_PER_CHIP = 100_000.0
+TARGET_PER_CHIP = {9: 100_000.0, 16: 10_000.0, 25: 1_000.0}[BENCH_SIZE]
 
 
 def _load_corpus():
@@ -37,7 +50,13 @@ def _load_corpus():
         return np.load(CORPUS_PATH)["boards"]
     from sudoku_solver_distributed_tpu.models import generate_batch
 
-    boards = generate_batch(BENCH_BATCH, 64, seed=20260729, unique=True)
+    boards = generate_batch(
+        BENCH_BATCH,
+        _HOLES[BENCH_SIZE],
+        size=BENCH_SIZE,
+        seed=20260729,
+        unique=True,
+    )
     os.makedirs(os.path.dirname(CORPUS_PATH), exist_ok=True)
     np.savez_compressed(CORPUS_PATH, boards=boards)
     return boards
@@ -48,13 +67,19 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
+    from sudoku_solver_distributed_tpu.ops import solve_batch, spec_for_size
 
+    spec = spec_for_size(BENCH_SIZE)
     boards = _load_corpus()
     clues = int((boards[0] > 0).sum())
 
     n_chips = max(1, len(jax.devices()))
-    solve = jax.jit(lambda g: solve_batch(g, SPEC_9, max_depth=64))
+    max_depth = 64 if BENCH_SIZE == 9 else None
+    solve = jax.jit(
+        lambda g: solve_batch(
+            g, spec, max_depth=max_depth, max_iters=_MAX_ITERS[BENCH_SIZE]
+        )
+    )
 
     dev_boards = jnp.asarray(boards)
     # warm up (compile) once
@@ -82,7 +107,9 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "puzzles_per_sec_per_chip_hard9x9",
+                "metric": (
+                    f"puzzles_per_sec_per_chip_hard{BENCH_SIZE}x{BENCH_SIZE}"
+                ),
                 "value": round(pps_per_chip, 1),
                 "unit": "puzzles/s/chip",
                 "vs_baseline": round(pps_per_chip / TARGET_PER_CHIP, 4),

@@ -26,9 +26,19 @@ def _solve(board: Board) -> Optional[Board]:
     return oracle_solve(board)
 
 
+# Uniqueness-probe node budget: bounds the pathological tail (a single
+# near-multi-solution probe on a 16×16 can otherwise take minutes). An
+# inconclusive probe reads as "not proven unique", so the blank is reverted —
+# certification stays sound, the puzzle just keeps one more clue.
+_COUNT_NODE_BUDGET = 30_000
+
+
 def _count(board: Board, limit: int) -> int:
     if native.available():
-        return native.native_count_solutions(board, limit=limit)
+        rc = native.native_count_solutions_budget(
+            board, limit=limit, max_nodes=_COUNT_NODE_BUDGET
+        )
+        return limit if rc is None else rc
     return count_solutions(board, limit=limit)
 
 
@@ -56,7 +66,24 @@ def generate_board(
             for j in range(box):
                 board[n + i][n + j] = nums.pop()
 
-    solved = _solve(board)
+    solved = None
+    if size > 9:
+        # Completing a near-empty large board with the deterministic MRV
+        # solver has a pathological tail (minutes on some 16×16 diagonal
+        # seeds); the randomized-restart native solver finishes in
+        # milliseconds and stays deterministic in the rng stream. 9×9 keeps
+        # the historical deterministic path so existing seeded corpora
+        # reproduce bit-for-bit. The seed is drawn unconditionally so the
+        # rng stream (and thus the blanking order below) is identical with
+        # or without the native toolchain.
+        solver_seed = rng.getrandbits(64)
+        if native.available():
+            try:
+                solved = native.native_solve_seeded(board, solver_seed)
+            except RuntimeError:
+                solved = None  # all restarts exhausted: exhaustive fallback
+    if solved is None:
+        solved = _solve(board)
     assert solved is not None, "diagonal seed must always be completable"
     board = solved
 

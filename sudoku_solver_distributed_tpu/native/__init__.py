@@ -86,6 +86,22 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_longlong,
     ]
     lib.ss_count.restype = ctypes.c_longlong
+    lib.ss_count_budget.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+        ctypes.c_longlong,
+        ctypes.c_longlong,
+    ]
+    lib.ss_count_budget.restype = ctypes.c_longlong
+    lib.ss_solve_seeded.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+        ctypes.c_uint64,
+        ctypes.c_longlong,
+        ctypes.c_int,
+    ]
+    lib.ss_solve_seeded.restype = ctypes.c_int
     return lib
 
 
@@ -143,4 +159,67 @@ def native_count_solutions(board: Sequence[Sequence[int]], limit: int = 2) -> in
     return int(rc)
 
 
-__all__ = ["available", "native_solve", "native_count_solutions"]
+def native_count_solutions_budget(
+    board: Sequence[Sequence[int]], limit: int = 2, max_nodes: int = 0
+) -> Optional[int]:
+    """As ``native_count_solutions`` but bounded to ``max_nodes`` search
+    nodes (0 = unbounded). Returns None when the budget ran out before the
+    count settled — "unknown", which certification callers must treat
+    conservatively (uniqueness probes on large boards have a pathological
+    tail: a near-multi-solution 16×16 can take minutes unbounded)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native oracle unavailable")
+    arr, ptr = _as_c_board(board)
+    rc = lib.ss_count_budget(ptr, arr.shape[0], limit, max_nodes)
+    if rc == -2:
+        return None
+    if rc < 0:
+        raise ValueError(f"bad board geometry: {arr.shape[0]}×{arr.shape[0]}")
+    return int(rc)
+
+
+def native_solve_seeded(
+    board: Sequence[Sequence[int]],
+    seed: int,
+    *,
+    max_nodes: int = 200_000,
+    restarts: int = 32,
+) -> Optional[List[List[int]]]:
+    """Randomized-restart solve (Las Vegas): candidate values in a
+    seeded-shuffled order, restarting on node-budget exhaustion.
+
+    Deterministic in ``seed``. Use for *generation-style* inputs that are
+    known satisfiable — deterministic MRV ordering has pathological tails on
+    large near-empty boards (minutes on some 16×16 diagonal seeds) that
+    shuffled restarts dodge with overwhelming probability. Returns None if
+    unsatisfiable; raises RuntimeError if every restart exhausted its budget
+    (adversarial input — fall back to the exhaustive solver)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native oracle unavailable")
+    arr, ptr = _as_c_board(board)
+    size = arr.shape[0]
+    out = np.zeros_like(arr)
+    rc = lib.ss_solve_seeded(
+        ptr,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        size,
+        seed & (2**64 - 1),
+        max_nodes,
+        restarts,
+    )
+    if rc == -1:
+        raise ValueError(f"bad board geometry: {size}×{size}")
+    if rc == -2:
+        raise RuntimeError("seeded solve: all restarts exhausted their budget")
+    return out.tolist() if rc == 1 else None
+
+
+__all__ = [
+    "available",
+    "native_solve",
+    "native_count_solutions",
+    "native_count_solutions_budget",
+    "native_solve_seeded",
+]

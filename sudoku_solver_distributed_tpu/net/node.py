@@ -177,6 +177,9 @@ class P2PNode:
     # -- message dispatch ---------------------------------------------------
     def handle_message(self, msg: wire.Msg) -> None:
         mtype = msg.get("type")
+        # the reference logs every datagram at INFO (node.py:194) as its
+        # observability-as-oracle; DEBUG here — /metrics supersedes it
+        logger.debug("received message: %s", msg)
         # Heartbeat refresh, keyed by the peer's *self-reported* id — the same
         # key membership.neighbors() holds. (Keying by UDP source address
         # breaks when a peer binds e.g. "localhost" but datagrams arrive from

@@ -30,7 +30,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -38,9 +37,7 @@ from .spec import BoardSpec
 from .solver import OVERFLOW, RUNNING, SOLVED, UNSAT, SolveResult
 
 
-def _mask_value(m):
-    """Value 1..N of a one-bit mask (0 for empty mask), elementwise."""
-    return jnp.where(m == 0, 0, jax.lax.population_count(m - 1) + 1)
+from .encode import mask_to_value as _mask_value  # pure lax ops: kernel-safe
 
 
 def _analyze_block(g, spec: BoardSpec):
@@ -287,8 +284,12 @@ def solve_batch_pallas(
     flat = grid.astype(jnp.int32).reshape(B, C)
     pad = (-B) % block
     if pad:
+        # pad with trivially contradictory boards (two equal clues in row 0):
+        # they go UNSAT in one iteration, so a mostly-pad block exits
+        # immediately — an empty-board pad would be the *deepest* 9×9 search
+        pad_board = jnp.zeros((C,), jnp.int32).at[0].set(1).at[1].set(1)
         flat = jnp.concatenate(
-            [flat, jnp.zeros((pad, C), jnp.int32)], axis=0
+            [flat, jnp.broadcast_to(pad_board, (pad, C))], axis=0
         )
     nblocks = flat.shape[0] // block
 

@@ -78,6 +78,19 @@ def test_native_count_empty_board_saturates():
     assert native.native_count_solutions(empty, limit=3) == 3
 
 
+def test_native_count_budget():
+    empty = [[0] * 9 for _ in range(9)]
+    # a 3-node budget cannot settle the count of an empty board → unknown
+    assert native.native_count_solutions_budget(empty, limit=2, max_nodes=3) is None
+    # generous budget settles it
+    assert native.native_count_solutions_budget(empty, limit=2, max_nodes=10**7) == 2
+    # budget state must not leak into subsequent unbudgeted calls
+    assert native.native_count_solutions(empty, limit=2) == 2
+    boards = generate_batch(2, 50, seed=13)
+    for b in boards.tolist():
+        assert native.native_solve(b) is not None
+
+
 def test_native_sizes_4_and_16():
     b4 = [[0] * 4 for _ in range(4)]
     sol = native.native_solve(b4)
@@ -90,6 +103,31 @@ def test_native_sizes_4_and_16():
 def test_bad_geometry_raises():
     with pytest.raises(ValueError):
         native.native_solve([[0] * 5 for _ in range(5)])
+
+
+def test_native_solve_seeded():
+    boards = generate_batch(4, 50, seed=14)
+    for b in boards.tolist():
+        sol = native.native_solve_seeded(b, seed=123)
+        assert sol is not None and oracle_is_valid_solution(sol)
+        for i in range(9):
+            for j in range(9):
+                if b[i][j]:
+                    assert sol[i][j] == b[i][j]
+    # deterministic in the seed
+    b0 = boards[0].tolist()
+    assert native.native_solve_seeded(b0, seed=7) == native.native_solve_seeded(
+        b0, seed=7
+    )
+    # unsat detected (full search completes within budget)
+    bad = [[0] * 9 for _ in range(9)]
+    bad[0][0] = bad[0][1] = 2
+    assert native.native_solve_seeded(bad, seed=1) is None
+    # 16×16 diagonal-seed completion — the case the deterministic order
+    # handles pathologically — finishes fast
+    b16 = [[0] * 16 for _ in range(16)]
+    sol16 = native.native_solve_seeded(b16, seed=99)
+    assert sol16 is not None and oracle_is_valid_solution(sol16)
 
 
 def test_generator_unique_certification_native():
