@@ -84,7 +84,10 @@ class SolverEngine:
                 axis=1,
             )
 
-        self._solve = jax.jit(_run, donate_argnums=0)
+        # no donate_argnums: the packed output can never alias the input
+        # buffer (different trailing shape), so donation would be a no-op
+        # that only emits "donated buffers were not usable" warnings
+        self._solve = jax.jit(_run)
 
     # -- internals ---------------------------------------------------------
     def _bucket_for(self, n: int) -> int:

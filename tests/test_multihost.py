@@ -1,0 +1,104 @@
+"""Multi-host distributed backend: two OS processes, one global mesh.
+
+The reference's multi-node story is N Python processes exchanging UDP
+datagrams (SURVEY.md §4.3). The TPU-native multi-HOST story is
+``jax.distributed``: every host runs the same program, the mesh spans all
+hosts' devices, and XLA collectives carry the data (ICI within a slice, DCN
+across — here the CPU collectives transport, same program shape). This test
+drives the exact code path behind the CLI's --coordinator/--num-hosts/
+--host-id flags with two real processes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import sys
+import jax
+
+coord, num, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(
+    coordinator_address=coord, num_processes=num, process_id=pid
+)
+assert jax.process_count() == num, jax.process_count()
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sudoku_solver_distributed_tpu.models import generate_batch
+from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
+
+mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+n_dev = mesh.devices.size
+
+# one board per device, globally sharded over both hosts' devices
+boards = generate_batch(n_dev, 40, seed=3)
+sharding = NamedSharding(mesh, P("data"))
+global_boards = jax.make_array_from_process_local_data(
+    sharding, boards[jax.process_index() :: num]
+)
+
+
+@jax.jit
+def step(g):
+    res = solve_batch(g, SPEC_9, max_depth=48)
+    return res.solved.sum()
+
+out = int(step(global_boards))
+assert out == n_dev, f"solved {out} of {n_dev}"
+print(f"host {pid}: mesh of {n_dev} devices over {num} processes OK", flush=True)
+"""
+
+
+def _free_tcp_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_distributed_mesh():
+    coord = f"127.0.0.1:{_free_tcp_port()}"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_COMPILATION_CACHE_DIR=os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_sudoku_tpu"
+        ),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep children off the TPU tunnel
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, coord, "2", str(pid)],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+        assert all(p.returncode == 0 for p in procs), "\n".join(outs)[-3000:]
+        assert any("mesh of 4 devices over 2 processes OK" in o for o in outs), (
+            "\n".join(outs)[-3000:]
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
