@@ -4,7 +4,7 @@ One mesh axis, ``data``, carries both parallel modes: puzzle batches are
 sharded along it (shard.py) and so are speculative search states
 (frontier.py). Multi-host pods extend the same mesh transparently —
 ``jax.devices()`` spans all hosts once ``jax.distributed.initialize`` has run
-(net/cluster.py), and XLA routes the collectives over ICI within a slice and
+(net/cli.py ``--coordinator``), and XLA routes the collectives over ICI within a slice and
 DCN across slices; nothing here changes.
 """
 
