@@ -1,10 +1,15 @@
 """Pallas VMEM-resident kernel vs the XLA compacted solver, on real TPU.
 
-Run (needs the tunneled chip): PYTHONPATH=/root/repo:$PYTHONPATH python
-benchmarks/exp_pallas.py
+Run (needs the tunneled chip): python benchmarks/exp_pallas.py
+(sys.path bootstrap below — PYTHONPATH breaks this environment's TPU
+plugin discovery, so don't set it.)
 """
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

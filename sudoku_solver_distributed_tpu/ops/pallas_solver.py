@@ -167,7 +167,12 @@ def _make_kernel(spec: BoardSpec, BLK: int, D: int, max_iters: int):
             key = jnp.where(
                 g == 0, jax.lax.population_count(cand), jnp.int32(1 << 30)
             )
-            cell = jnp.argmin(key, axis=1).astype(jnp.int32)  # (BLK,)
+            # integer argmin (Mosaic has no int argmin): min value, then the
+            # lowest cell index attaining it
+            min_key = jnp.min(key, axis=1, keepdims=True)     # (BLK, 1)
+            cell = jnp.min(
+                jnp.where(key == min_key, iota_c, jnp.int32(1 << 30)), axis=1
+            )                                                  # (BLK,)
             cell_hot = iota_c == cell[:, None]                # (BLK, C)
             mrv_mask = jnp.sum(jnp.where(cell_hot, cand, 0), axis=1)
             guess_bit = mrv_mask & -mrv_mask
@@ -255,7 +260,9 @@ def _make_kernel(spec: BoardSpec, BLK: int, D: int, max_iters: int):
         status_out[:] = status
         guesses_out[:] = guesses
         vals_out[:] = vals
-        iters_out[0, 0] = it
+        # per-board lane (a (1,1)-blocked SMEM scalar fails Mosaic's
+        # (8,128)-divisibility rule); reduced with max() host-side
+        iters_out[:] = jnp.full((BLK, 1), it, jnp.int32)
 
     return kernel
 
@@ -302,7 +309,7 @@ def solve_batch_pallas(
             jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
             jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
             jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
-            jax.ShapeDtypeStruct((nblocks, 1), jnp.int32),
+            jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
         ),
         in_specs=[
             pl.BlockSpec((block, C), lambda i: (i, 0), memory_space=pltpu.VMEM)
@@ -312,7 +319,7 @@ def solve_batch_pallas(
             pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
     )(flat)

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .spec import BoardSpec
-from .encode import _counts_to_mask, box_index, mask_to_value, unit_value_counts
+from .encode import box_index, mask_to_value
 
 
 class Analysis(NamedTuple):
@@ -32,6 +32,36 @@ class Analysis(NamedTuple):
     assign: jnp.ndarray         # (B, N, N) int32 single-bit forced-value mask
     contradiction: jnp.ndarray  # (B,) bool — unsatisfiable as-is
     solved: jnp.ndarray         # (B,) bool — strict: every unit a permutation
+
+
+def _box_major(x: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
+    """(B, N, N) cell tensor → (B, N, N) with axis 1 = box id (matching
+    ``box_index``) and axis 2 = cell position within the box."""
+    n, N = spec.box, spec.size
+    B = x.shape[0]
+    return (
+        x.reshape(B, n, n, n, n).transpose(0, 1, 3, 2, 4).reshape(B, N, N)
+    )
+
+
+def _once_twice(x: jnp.ndarray):
+    """Saturating 2-bit bitmask accumulation along the last axis.
+
+    For per-cell masks x[..., k], returns (once, twice): bits set in ≥1 /
+    ≥2 of the cells. ``once`` is the unit's used/admitting mask; ``twice``
+    exposes duplicates (on value masks) and multi-cell candidates (on
+    candidate masks: once & ~twice = values with exactly one admitting
+    cell — the hidden singles). An unrolled OR tree over N lanes of
+    elementwise int32 ops, replacing the (B, N, N, V) one-hot histograms
+    this sweep used to build (~N× less HBM traffic per iteration).
+    """
+    once = jnp.zeros_like(x[..., 0])
+    twice = once
+    for k in range(x.shape[-1]):
+        m = x[..., k]
+        twice = twice | (once & m)
+        once = once | m
+    return once, twice
 
 
 def analyze(grid: jnp.ndarray, spec: BoardSpec) -> Analysis:
@@ -46,47 +76,49 @@ def analyze(grid: jnp.ndarray, spec: BoardSpec) -> Analysis:
     1..N (reference sudoku.py:119-140) — not the reference's weak sum-only
     fork (node.py:97-114) whose acceptance of a row of nine 5s is a defect.
     """
-    n, N = spec.box, spec.size
-    B = grid.shape[0]
+    N = spec.size
+    g = grid.astype(jnp.int32)
+    in_range = (g >= 1) & (g <= N)
+    vmask = jnp.where(
+        in_range, jnp.left_shift(jnp.int32(1), jnp.clip(g - 1, 0, 31)), 0
+    )  # (B, N, N); out-of-range cells contribute nothing (flagged below)
 
-    rows, cols, boxes = unit_value_counts(grid, spec)  # (B, N, V) each
-    dup = (
-        (rows > 1).any(axis=(1, 2))
-        | (cols > 1).any(axis=(1, 2))
-        | (boxes > 1).any(axis=(1, 2))
-    )
-    solved = (
-        (rows == 1).all(axis=(1, 2))
-        & (cols == 1).all(axis=(1, 2))
-        & (boxes == 1).all(axis=(1, 2))
-    )
-
-    shifts = jnp.arange(N, dtype=jnp.int32)
-    row_used = _counts_to_mask(rows, spec)
-    col_used = _counts_to_mask(cols, spec)
-    box_used = _counts_to_mask(boxes, spec)
     bidx = box_index(spec)
+    row_used, row_dup = _once_twice(vmask)                    # (B, N) each
+    col_used, col_dup = _once_twice(vmask.swapaxes(1, 2))
+    box_used, box_dup = _once_twice(_box_major(vmask, spec))
+    dup = (
+        (row_dup != 0).any(axis=1)
+        | (col_dup != 0).any(axis=1)
+        | (box_dup != 0).any(axis=1)
+    )
+
     used = row_used[:, :, None] | col_used[:, None, :] | box_used[:, bidx]
     empty = grid == 0
     cand = jnp.where(empty, ~used & jnp.int32(spec.full_mask), jnp.int32(0))
 
-    conehot = (jnp.right_shift(cand[..., None], shifts) & 1).astype(jnp.int32)
-    row_tot = conehot.sum(axis=2)  # (B, N, V): admitting cells per (row, value)
-    col_tot = conehot.sum(axis=1)
-    box_tot = conehot.reshape(B, n, n, n, n, N).sum(axis=(2, 4)).reshape(B, N, N)
-    hidden = conehot & (
-        (row_tot[:, :, None, :] == 1)
-        | (col_tot[:, None, :, :] == 1)
-        | (box_tot[:, bidx, :] == 1)
-    ).astype(jnp.int32)
-    hidden_mask = jnp.left_shift(hidden, shifts).sum(axis=-1)
+    # Hidden singles: a value with exactly one admitting cell in some unit is
+    # forced at that cell — and "this cell admits v AND v has one admitting
+    # cell in my unit" identifies it without per-(unit, value) cell counts.
+    row_o, row_t = _once_twice(cand)
+    col_o, col_t = _once_twice(cand.swapaxes(1, 2))
+    box_o, box_t = _once_twice(_box_major(cand, spec))
+    exact1 = (
+        (row_o & ~row_t)[:, :, None]
+        | (col_o & ~col_t)[:, None, :]
+        | (box_o & ~box_t)[:, bidx]
+    )
+    hidden_mask = cand & exact1
 
     naked = jax.lax.population_count(cand) == 1
     assign = jnp.where(naked, cand, hidden_mask)
     assign = assign & -assign  # one value per cell per sweep
 
     dead = (empty & (cand == 0)).any(axis=(1, 2))
-    bad_value = ((grid < 0) | (grid > N)).any(axis=(1, 2))
+    bad_value = ((g < 0) | (g > N)).any(axis=(1, 2))
+    # filled + no unit duplicate + all values in range ⇔ every unit holds N
+    # distinct in-range values ⇔ every unit is a permutation of 1..N.
+    solved = (~empty).all(axis=(1, 2)) & ~dup & ~bad_value
     return Analysis(cand, assign, dup | dead | bad_value, solved)
 
 
