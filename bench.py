@@ -2,6 +2,18 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Modes (BENCH_MODE env var):
+  throughput (default) — solve the cached hard corpus, report puzzles/s/chip
+    vs the ≥100k/chip north-star target (BASELINE.md).
+  latency — start a warmed single node (the real CLI + HTTP stack), fire the
+    README 8-clue puzzle at POST /solve repeatedly, report p50 in ms vs the
+    <5 ms north-star target (vs_baseline = 5/p50, so ≥1.0 meets it). The
+    reference's only latency artifact is its execution_time log line
+    (reference node.py:681-683; 168.4 s on this same puzzle, BASELINE.md).
+    Note: through a tunneled TPU each blocking request pays the tunnel RTT
+    (~70 ms here); p95/min and the request breakdown go to stderr so the
+    artifact records both the serving-stack cost and the link cost.
+
 The reference publishes no benchmark numbers (BASELINE.md); its measured
 equivalent is ~0.006 puzzles/s on the README 8-clue board (168.4 s, single
 node). The north-star target from BASELINE.json is ≥100k 17-clue-class
@@ -124,5 +136,129 @@ def main():
     )
 
 
+README_PUZZLE = [
+    [0, 0, 0, 1, 0, 0, 0, 0, 0],
+    [0, 0, 0, 3, 2, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 9, 0, 0, 0],
+    [0, 0, 0, 0, 0, 0, 0, 7, 0],
+    [0, 0, 0, 0, 0, 0, 0, 0, 0],
+    [0, 0, 0, 9, 0, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 0, 9, 0, 0],
+    [0, 0, 0, 0, 0, 0, 0, 0, 3],
+    [0, 0, 0, 0, 0, 0, 0, 0, 0],
+]  # reference README.md:20 — its 168.4 s single-node board (BASELINE.md)
+
+
+def main_latency():
+    import subprocess
+    import urllib.request
+
+    import numpy as np
+
+    # pid-derived ports so a stale node from a crashed earlier run can't
+    # answer this run's probes and get benchmarked in place of our child
+    http_port = 18000 + os.getpid() % 700
+    udp_port = http_port - 1000
+    reps = int(os.environ.get("BENCH_LATENCY_REPS", "40"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    body = json.dumps({"sudoku": README_PUZZLE}).encode()
+
+    def post_solve(timeout=300.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/solve",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            payload = json.loads(r.read())
+        return (time.perf_counter() - t0) * 1e3, payload
+
+    # handicap 0: the artifact measures the serving stack (warm compiled
+    # engine + HTTP + P2P bookkeeping), not the reference's simulated-work
+    # sleeps, which -h scales (reference node.py:89-95)
+    # BENCH_PLATFORM=cpu serves from the local CPU backend — the co-located-
+    # device proxy when the only TPU is behind a high-RTT tunnel
+    platform = os.environ.get("BENCH_PLATFORM")
+    extra = ["--platform", platform] if platform else []
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(repo, "node.py"),
+            "-p", str(http_port), "-s", str(udp_port), "-h", "0",
+        ]
+        + extra,
+        cwd=repo,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        # wait for HTTP up, then for warm buckets: solve until fast twice
+        deadline = time.time() + 180
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node exited rc={proc.returncode} before serving "
+                    f"(ports {http_port}/{udp_port} busy?)"
+                )
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/stats", timeout=2
+                )
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise RuntimeError("node did not come up") from None
+                time.sleep(0.5)
+        fast = 0
+        while fast < 2 and time.time() < deadline:
+            ms, _ = post_solve()
+            fast = fast + 1 if ms < 500 else 0
+        if fast < 2:
+            print(
+                "# WARNING: warm criterion (2 consecutive <500ms solves) not "
+                "met before deadline — measured p50 may include compile time",
+                file=sys.stderr,
+            )
+
+        times = []
+        for _ in range(reps):
+            ms, payload = post_solve()
+            assert payload[0][3] == 1 and all(
+                all(v != 0 for v in row) for row in payload
+            ), "bad README solve"
+            times.append(ms)
+        times = np.asarray(times)
+        p50 = float(np.percentile(times, 50))
+        p95 = float(np.percentile(times, 95))
+        print(
+            json.dumps(
+                {
+                    "metric": "p50_solve_http_latency_readme9x9",
+                    "value": round(p50, 2),
+                    "unit": "ms",
+                    "vs_baseline": round(5.0 / p50, 4),
+                }
+            )
+        )
+        print(
+            f"# reps={reps} platform={platform or 'default'} "
+            f"p50={p50:.2f}ms p95={p95:.2f}ms "
+            f"min={times.min():.2f}ms max={times.max():.2f}ms "
+            f"(blocking HTTP; on a tunneled chip each request pays the "
+            f"host<->TPU link RTT)",
+            file=sys.stderr,
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MODE", "throughput") == "latency":
+        main_latency()
+    else:
+        main()

@@ -86,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--num-hosts", type=int, default=1)
     parser.add_argument("--host-id", type=int, default=0)
+    parser.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu"],
+        help="force the JAX platform (default: the environment's default "
+        "backend). Uses jax.config pre-init — env-var routes are "
+        "unreliable where a sitecustomize pins JAX_PLATFORMS",
+    )
     return parser
 
 
@@ -97,6 +105,11 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s - %(levelname)s - %(message)s"
     )
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     if args.coordinator:
         # Pod-slice mode: every host runs this same CLI; XLA collectives ride
