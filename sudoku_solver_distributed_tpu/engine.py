@@ -39,6 +39,15 @@ class SolverEngine:
       sharding: optional jax.sharding.Sharding for the batch axis — supply a
         NamedSharding over a device mesh to fan one bucket out across chips
         (the TPU-native analog of the reference's peer task farm).
+      frontier_mesh: optional jax.sharding.Mesh — when set, single-board
+        ``solve_one`` requests are routed through the sharded search-frontier
+        race (parallel/frontier.py): the board's DFS subtrees are raced
+        across the mesh with a per-iteration early-exit psum. This makes the
+        multi-chip latency path the serving path for ``POST /solve``, the
+        way the reference's distributed dispatch is its serving path
+        (reference node.py:427-475).
+      frontier_states_per_device: speculative states seeded per chip for the
+        frontier race.
     """
 
     def __init__(
@@ -48,11 +57,15 @@ class SolverEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         max_depth: Optional[int] = None,
         sharding: Optional[jax.sharding.Sharding] = None,
+        frontier_mesh: Optional[jax.sharding.Mesh] = None,
+        frontier_states_per_device: int = 64,
     ):
         self.spec = spec
         self.buckets = tuple(sorted(set(buckets)))
         self.max_depth = max_depth
         self.sharding = sharding
+        self.frontier_mesh = frontier_mesh
+        self.frontier_states_per_device = frontier_states_per_device
         # when set, batch device calls are captured as jax.profiler traces
         # under this directory (utils/profiling.py; CLI --profile-dir); only
         # one trace can be active per process, so concurrent requests skip
@@ -137,6 +150,28 @@ class SolverEngine:
             jax.block_until_ready(
                 self._solve(self._device_batch(np.zeros((b, N, N), np.int32)))
             )
+        if self.frontier_mesh is not None:
+            # compile the frontier race for the bucket ladder requests hit
+            # in practice (seeding overshoots by a data-dependent factor ≤ N,
+            # so frontier_solve pads to states_per_device × 2^k per device —
+            # warm the first few rungs, raced on instantly-unsat pad states
+            # so no counter or solution side effects; larger rungs compile
+            # lazily on first hit). The direct racer call mirrors how bucket
+            # warmup calls self._solve.
+            from .parallel import frontier
+            import jax.numpy as jnp
+
+            n_dev = self.frontier_mesh.devices.size
+            target = n_dev * self.frontier_states_per_device
+            frontier.warm_seeding(self.spec, target)
+            racer = frontier._make_racer(
+                self.frontier_mesh, self.spec, 65536, self.max_depth
+            )
+            for mult in (1, 2, 4):
+                pad = np.broadcast_to(
+                    frontier._unsat_pad(self.spec), (target * mult, N, N)
+                )
+                np.asarray(racer(jnp.asarray(pad)))
 
     def solve_batch_np(self, boards: np.ndarray) -> Tuple[np.ndarray, np.ndarray, dict]:
         """Solve (B, N, N) boards.
@@ -166,10 +201,49 @@ class SolverEngine:
             "guesses": guesses,
         }
 
-    def solve_one(self, board: Sequence[Sequence[int]]) -> Tuple[Optional[List[List[int]]], dict]:
-        """Solve a single board; returns (solution | None, info)."""
-        arr = np.asarray(board, np.int32)[None]
-        solutions, solved_mask, info = self.solve_batch_np(arr)
+    def _frontier_raw(self, arr: np.ndarray):
+        """Run the race without serving-stats side effects (warmup uses
+        this directly, mirroring how bucket warmup calls self._solve)."""
+        from .parallel import frontier_solve
+
+        solution, info = frontier_solve(
+            arr,
+            self.frontier_mesh,
+            self.spec,
+            states_per_device=self.frontier_states_per_device,
+            max_depth=self.max_depth,
+        )
+        return solution, dict(info, frontier=True)
+
+    def _frontier_solve(self, arr: np.ndarray):
+        solution, info = self._frontier_raw(arr)
+        with self._lock:
+            self.validations += info["validations"]
+            if solution is not None:
+                self.solved_puzzles += 1
+        return solution, info
+
+    def solve_one(
+        self,
+        board: Sequence[Sequence[int]],
+        *,
+        frontier: Optional[bool] = None,
+    ) -> Tuple[Optional[List[List[int]]], dict]:
+        """Solve a single board; returns (solution | None, info).
+
+        With ``frontier_mesh`` configured, requests run the mesh-sharded
+        subtree race instead of a bucket-1 batch solve. ``frontier=False``
+        forces the bucket path for a single call — the P2P worker's per-cell
+        tasks use it so farmed cells never occupy the whole mesh."""
+        arr = np.asarray(board, np.int32)
+        use_frontier = (
+            self.frontier_mesh is not None
+            if frontier is None
+            else (frontier and self.frontier_mesh is not None)
+        )
+        if use_frontier:
+            return self._frontier_solve(arr)
+        solutions, solved_mask, info = self.solve_batch_np(arr[None])
         if not solved_mask[0]:
             return None, info
         return solutions[0].tolist(), info

@@ -87,6 +87,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--num-hosts", type=int, default=1)
     parser.add_argument("--host-id", type=int, default=0)
     parser.add_argument(
+        "--frontier",
+        type=int,
+        default=0,
+        metavar="STATES_PER_DEVICE",
+        help="route single-board /solve through the mesh-sharded search-"
+        "frontier race with this many speculative states per chip "
+        "(0 = off: bucket-1 batch solve)",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         choices=["cpu", "tpu"],
@@ -128,6 +137,11 @@ def main(argv=None) -> None:
     kwargs = {"spec": spec_for_size(args.board_size)}
     if args.buckets:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
+    if args.frontier > 0:
+        from ..parallel import default_mesh
+
+        kwargs["frontier_mesh"] = default_mesh()
+        kwargs["frontier_states_per_device"] = args.frontier
     engine = SolverEngine(**kwargs)
     from ..utils.profiling import RequestMetrics
 

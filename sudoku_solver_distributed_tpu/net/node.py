@@ -303,7 +303,9 @@ class P2PNode:
         self._current_task = (row, col)
         try:
             self.limiter.tick()  # the handicap contract, one tick per task
-            solution, _ = self.engine.solve_one(board)
+            # bucket path always: a farmed per-cell task must not occupy the
+            # whole mesh the way a frontier-routed serving request does
+            solution, _ = self.engine.solve_one(board, frontier=False)
             value = solution[row][col] if solution is not None else None
             self.send_to(
                 origin, wire.solution_msg(board, row, col, value, self.id)
@@ -395,7 +397,7 @@ class P2PNode:
                 # board unsat — replaces the reference's swap-repair
                 # (node.py:487-532) — or (b) every worker departed mid-solve
                 # (the reference would dispatch to dead peers forever).
-                solution, _ = self.engine.solve_one(sudoku)
+                solution, _ = self.engine.solve_one(sudoku, frontier=False)
                 return solution
 
             if done:
@@ -405,7 +407,7 @@ class P2PNode:
             return None
         # strict final check on the engine (reference runs its weak check,
         # node.py:466)
-        solution, _ = self.engine.solve_one(board)
+        solution, _ = self.engine.solve_one(board, frontier=False)
         return solution
 
     @staticmethod

@@ -18,7 +18,8 @@ DCN across), and the per-iteration collective is a single int32.
 
 from __future__ import annotations
 
-from functools import partial
+import contextlib
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import jax
@@ -38,6 +39,31 @@ def _unsat_pad(spec: BoardSpec) -> np.ndarray:
     board[0, 0] = 1
     board[0, 1] = 1
     return board
+
+
+@lru_cache(maxsize=None)
+def _seed_jits(spec: BoardSpec):
+    """Per-spec jitted seeding helpers. Cached on the spec so repeated
+    ``seed_frontier`` calls (every frontier-routed ``/solve``) reuse the
+    compiled programs instead of re-tracing fresh closures each request."""
+    analyze_j = jax.jit(partial(analyze, spec=spec))
+    assign_j = jax.jit(
+        lambda g, a: jnp.where((g == 0) & (a != 0), mask_to_value(a), g)
+    )
+    return analyze_j, assign_j
+
+
+def _seed_device():
+    """Device for the host-driven seeding BFS: the local CPU backend.
+
+    Seeding is a handful of tiny (≤ a few hundred boards) analyze/split
+    rounds with a host decision between each — on a remote/tunneled
+    accelerator every round would pay the link RTT, which dominates the
+    serving p50. The race itself still runs on the mesh devices."""
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:  # no CPU backend registered — stay on the default
+        return None
 
 
 def seed_frontier(
@@ -63,34 +89,64 @@ def seed_frontier(
         # each round either assigns singles (≤ cells of them) or splits
         max_rounds = spec.cells + 16
     states = np.asarray(board, np.int32)[None]
-    analyze_j = jax.jit(partial(analyze, spec=spec))
-    assign_j = jax.jit(
-        lambda g, a: jnp.where((g == 0) & (a != 0), mask_to_value(a), g)
+    analyze_j, assign_j = _seed_jits(spec)
+    seed_dev = _seed_device()
+    ctx = (
+        jax.default_device(seed_dev)
+        if seed_dev is not None
+        else contextlib.nullcontext()
     )
+    with ctx:
+        return _seed_rounds(
+            states, spec, target, max_rounds, analyze_j, assign_j
+        )
 
+
+def _pow2_pad(states: np.ndarray, spec: BoardSpec) -> np.ndarray:
+    """Pad the state batch up to the next power of two with instantly-unsat
+    boards. Seeding's state count is data-dependent; without bucketing every
+    round of every request would present the jitted analyze with a fresh
+    shape and pay an XLA compile. Pow2-bucketed, the shape set is small,
+    cacheable, and warmable ahead of serving (``warm_seeding``)."""
+    M = len(states)
+    P2 = 1 << max(0, M - 1).bit_length()
+    if P2 > M:
+        pad = np.broadcast_to(
+            _unsat_pad(spec), (P2 - M, spec.size, spec.size)
+        )
+        states = np.concatenate([states, pad], axis=0)
+    return states
+
+
+def _seed_rounds(states, spec, target, max_rounds, analyze_j, assign_j):
     for _ in range(max_rounds):
-        a = analyze_j(jnp.asarray(states))
+        real = len(states)  # states[:real] are genuine; the rest is padding
+        padded = _pow2_pad(states, spec)
+        a = analyze_j(jnp.asarray(padded))
         solved = np.asarray(a.solved)
         if solved.any():
-            return states, states[int(np.argmax(solved))]
+            # pads are contradictory, never solved: argmax lands on a real row
+            return states, padded[int(np.argmax(solved))]
         live = ~np.asarray(a.contradiction)
+        live[real:] = False  # drop padding along with dead real states
         if not live.any():
             # unsat root: hand back dead boards; the solver will report UNSAT
             break
         assign = np.asarray(a.assign)
         if (assign[live] != 0).any():
             # propagate singles everywhere before splitting
-            states = np.asarray(assign_j(jnp.asarray(states), jnp.asarray(assign)))
-            states = states[live]
+            padded = np.asarray(
+                assign_j(jnp.asarray(padded), jnp.asarray(assign))
+            )
+            states = padded[live]
             continue
-        states = states[live]
+        states = padded[live]
         if len(states) >= target:
             return states, None
-        # k-way split every state on its MRV cell
+        # k-way split every state on its MRV cell (host numpy: the counts are
+        # tiny and eager device ops would compile per shape)
         cand = np.asarray(a.cand)[live].reshape(len(states), -1)
-        pc = np.asarray(
-            jax.lax.population_count(jnp.asarray(cand))
-        )
+        pc = sum((cand >> k) & 1 for k in range(spec.size))
         pc = np.where(cand != 0, pc, 10**6)
         cells = pc.argmin(axis=1)
         children = []
@@ -107,6 +163,11 @@ def seed_frontier(
                 child[i, j] = bit.bit_length()
                 children.append(child)
         states = np.stack(children)
+        if len(states) >= target:
+            # return without re-analyzing the overshoot (children can number
+            # up to target×N; the racer propagates/solves them anyway, and
+            # skipping keeps the analyzed shape set bounded by pow2(target))
+            return states, None
 
     if len(states) < target:
         pad = np.broadcast_to(
@@ -116,8 +177,34 @@ def seed_frontier(
     return states, None
 
 
+def warm_seeding(spec: BoardSpec, target: int) -> None:
+    """Pre-compile the seeding programs for every pow2 state-batch shape up
+    to ``pow2(target)``, on the seeding device — so a server's first
+    frontier-routed request pays no seeding compiles."""
+    analyze_j, assign_j = _seed_jits(spec)
+    seed_dev = _seed_device()
+    ctx = (
+        jax.default_device(seed_dev)
+        if seed_dev is not None
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        m = 1
+        while True:
+            z = jnp.zeros((m, spec.size, spec.size), jnp.int32)
+            a = analyze_j(z)
+            jax.block_until_ready(assign_j(z, a.assign))
+            if m >= target:
+                break
+            m *= 2
+
+
+@lru_cache(maxsize=None)
 def _make_racer(mesh, spec: BoardSpec, max_iters: int, max_depth: Optional[int]):
-    """Compile the shard_map race: lockstep DFS with per-iteration early exit."""
+    """Compile the shard_map race: lockstep DFS with per-iteration early exit.
+
+    Cached on (mesh, spec, max_iters, max_depth) — a fresh closure per call
+    would re-trace under jit on every frontier-routed request."""
 
     from jax.sharding import PartitionSpec as P
 
@@ -125,7 +212,7 @@ def _make_racer(mesh, spec: BoardSpec, max_iters: int, max_depth: Optional[int])
         jax.shard_map,
         mesh=mesh,
         in_specs=(P("data"),),
-        out_specs=(P(), P(), P()),
+        out_specs=P(),
         check_vma=False,  # while_loop carry starts unvarying (see shard.py)
     )
     def race(states):  # (K, N, N) per device
@@ -158,10 +245,19 @@ def _make_racer(mesh, spec: BoardSpec, max_iters: int, max_depth: Optional[int])
         has_g = jax.lax.all_gather(local_has, "data")        # (n_dev,)
         sol_g = jax.lax.all_gather(local_sol, "data")        # (n_dev, C)
         winner = jnp.argmax(has_g)  # first True, or 0 if none
-        solution = sol_g[winner].reshape(spec.size, spec.size)
+        solution = sol_g[winner]
         found_any = has_g.any()
         validations = jax.lax.psum(st.validations.sum(), "data")
-        return solution, found_any, validations
+        # one packed output row = one device→host transfer per request
+        # (three outputs would be three fetches — ~an RTT each on a
+        # tunneled device; same trick as engine.SolverEngine._run)
+        return jnp.concatenate(
+            [
+                solution,
+                found_any.astype(jnp.int32)[None],
+                validations[None],
+            ]
+        )
 
     return jax.jit(race)
 
@@ -191,19 +287,27 @@ def frontier_solve(
 
     # Never drop a seeded state — each covers a disjoint slice of the search
     # space, so dropping one could lose the only solution. Round the count up
-    # to a multiple of the mesh with instantly-unsat padding instead.
+    # with instantly-unsat padding instead — to a *geometric shape bucket*
+    # (states_per_device × 2^k per device), not the tight multiple: seeding
+    # overshoots by a data-dependent amount (the last split round fans each
+    # parent into ≤N children), and a tight pad would give every request its
+    # own racer shape → a fresh XLA compile per /solve. Bucketed, the cached
+    # racer (lru_cache above + jit shape cache) is warm after the first hit.
     K = -(-len(states) // n_dev)  # ceil
-    total = n_dev * K
+    bucket = max(states_per_device, 1)
+    while bucket < K:
+        bucket *= 2
+    total = n_dev * bucket
     if len(states) < total:
         pad = np.broadcast_to(
             _unsat_pad(spec), (total - len(states), spec.size, spec.size)
         )
         states = np.concatenate([states, pad], axis=0)
     racer = _make_racer(mesh, spec, max_iters, max_depth)
-    sol, found, validations = racer(jnp.asarray(states))
-    if not bool(found):
-        return None, {"validations": int(validations), "seeded": len(states)}
-    return np.asarray(sol).tolist(), {
-        "validations": int(validations),
-        "seeded": len(states),
-    }
+    packed = np.asarray(racer(jnp.asarray(states)))
+    C = spec.cells
+    found, validations = bool(packed[C]), int(packed[C + 1])
+    info = {"validations": validations, "seeded": len(states)}
+    if not found:
+        return None, info
+    return packed[:C].reshape(spec.size, spec.size).tolist(), info

@@ -244,3 +244,59 @@ def test_mesh_pseudo_peers(engine):
     node = P2PNode("127.0.0.1", port, engine=engine, mesh_peer_count=4)
     view = node.network_view()
     assert view == {node.id: [f"{node.id}/tpu{k}" for k in range(4)]}
+
+
+def test_http_solve_frontier_path(readme_puzzle):
+    """POST /solve on the README board executes the mesh-sharded frontier
+    race (the multi-chip latency path IS the serving path, the way the
+    reference's distributed dispatch is its serving path, node.py:427-475)."""
+    from sudoku_solver_distributed_tpu.parallel import default_mesh
+
+    eng = SolverEngine(
+        buckets=(1,),
+        frontier_mesh=default_mesh(),
+        frontier_states_per_device=8,
+    )
+    eng.warmup()
+    # warmup compiles the race without polluting serving counters
+    assert eng.solved_puzzles == 0 and eng.validations == 0
+    calls = []
+    orig = eng._frontier_solve
+
+    def spy(arr):
+        out = orig(arr)
+        calls.append(out[1])
+        return out
+
+    eng._frontier_solve = spy
+
+    port = free_port()
+    node = P2PNode("127.0.0.1", port, engine=eng)
+    t = threading.Thread(target=node.run, daemon=True)
+    t.start()
+    httpd = None
+    try:
+        http_port = free_port()
+        httpd = make_http_server(node, "127.0.0.1", http_port)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/solve",
+            data=json.dumps({"sudoku": readme_puzzle}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            solution = json.loads(resp.read())
+        assert oracle_is_valid_solution(solution)
+        # clues preserved
+        for i in range(9):
+            for j in range(9):
+                if readme_puzzle[i][j]:
+                    assert solution[i][j] == readme_puzzle[i][j]
+        # the frontier path actually served the request (warmup isn't spied)
+        assert len(calls) == 1 and calls[0]["frontier"] is True
+        assert calls[0]["seeded"] >= 8 * 8  # states_per_device × mesh size
+        assert eng.validations > 0
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        node.shutdown()
