@@ -225,6 +225,54 @@ class SolverEngine:
                 self.solved_puzzles += 1
         return solution, info
 
+    def solve_batch_resumable_np(
+        self,
+        boards: np.ndarray,
+        checkpoint_path: str,
+        *,
+        chunk_iters: int = 256,
+        max_iters: int = 65536,
+        keep_checkpoint: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray, dict]:
+        """``solve_batch_np`` with crash durability: the solve advances in
+        bounded chunks with an atomic .npz snapshot between chunks
+        (utils/checkpoint.py), and a re-run with the same ``checkpoint_path``
+        resumes bit-exact from the snapshot instead of restarting. For the
+        long batches where a lost solve is expensive — the reference loses
+        everything on a kill (SURVEY.md §5; its `pickle` import is dead code,
+        reference node.py:11).
+
+        Returns (solutions, solved_mask, info) like ``solve_batch_np``. The
+        snapshot carries the per-board counters, so a resumed run folds the
+        batch's *full* effort (pre-kill + post-resume) into this engine's
+        stats — the killed process's in-RAM counters died with it, and the
+        work must be attributed exactly once.
+        """
+        from .utils.checkpoint import solve_batch_resumable
+
+        boards = np.asarray(boards, np.int32)
+        res = solve_batch_resumable(
+            boards,
+            self.spec,
+            checkpoint_path=checkpoint_path,
+            chunk_iters=chunk_iters,
+            max_iters=max_iters,
+            max_depth=self.max_depth,
+            keep_checkpoint=keep_checkpoint,
+            sharding=self.sharding,
+        )
+        solved_mask = np.asarray(res.solved)
+        validations = int(np.asarray(res.validations).sum())
+        guesses = int(np.asarray(res.guesses).sum())
+        with self._lock:
+            self.validations += validations
+            self.solved_puzzles += int(solved_mask.sum())
+        return (
+            np.asarray(res.grid),
+            solved_mask,
+            {"validations": validations, "guesses": guesses},
+        )
+
     def solve_one(
         self,
         board: Sequence[Sequence[int]],

@@ -43,11 +43,30 @@ _FIELDS = (
 )
 
 
-def save_solver_state(path: str, state: S._State, spec: BoardSpec) -> None:
+def boards_fingerprint(boards: np.ndarray) -> np.ndarray:
+    """Identity of the request batch, stored in the snapshot so a stale
+    checkpoint can never be resumed against different boards (same-geometry
+    batches would otherwise silently return the *old* batch's solutions)."""
+    import hashlib
+
+    digest = hashlib.sha256(
+        np.ascontiguousarray(np.asarray(boards, np.int32)).tobytes()
+    ).digest()
+    return np.frombuffer(digest, np.uint8)
+
+
+def save_solver_state(
+    path: str,
+    state: S._State,
+    spec: BoardSpec,
+    boards_hash: Optional[np.ndarray] = None,
+) -> None:
     """Atomically snapshot a solver state pytree to ``path`` (.npz)."""
     arrays = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
     arrays["__format__"] = np.int64(_FORMAT)
     arrays["__box__"] = np.int64(spec.box)
+    if boards_hash is not None:
+        arrays["__boards_sha256__"] = np.asarray(boards_hash, np.uint8)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -61,8 +80,13 @@ def save_solver_state(path: str, state: S._State, spec: BoardSpec) -> None:
         raise
 
 
-def load_solver_state(path: str) -> Tuple[S._State, BoardSpec]:
-    """Restore a snapshot written by ``save_solver_state``."""
+def load_solver_state(
+    path: str,
+) -> Tuple[S._State, BoardSpec, Optional[np.ndarray]]:
+    """Restore a snapshot written by ``save_solver_state``.
+
+    Returns (state, spec, boards_hash) — boards_hash is None for snapshots
+    saved without one."""
     with np.load(path) as z:
         if int(z["__format__"]) != _FORMAT:
             raise ValueError(
@@ -70,13 +94,22 @@ def load_solver_state(path: str) -> Tuple[S._State, BoardSpec]:
             )
         spec = BoardSpec(box=int(z["__box__"]))
         state = S._State(**{f: z[f] for f in _FIELDS})
+        boards_hash = (
+            np.asarray(z["__boards_sha256__"])
+            if "__boards_sha256__" in z
+            else None
+        )
     C = spec.cells
     if state.grid.ndim != 2 or state.grid.shape[1] != C:
         raise ValueError(
             f"checkpoint grid shape {state.grid.shape} does not match "
             f"{spec.size}×{spec.size} boards"
         )
-    return jax.tree.map(lambda x: jax.numpy.asarray(x), state), spec
+    return (
+        jax.tree.map(lambda x: jax.numpy.asarray(x), state),
+        spec,
+        boards_hash,
+    )
 
 
 @partial(jax.jit, static_argnames=("spec", "chunk", "max_iters"))
@@ -99,19 +132,26 @@ def solve_batch_resumable(
     max_iters: int = 65536,
     max_depth: Optional[int] = None,
     keep_checkpoint: bool = False,
+    sharding=None,
 ) -> S.SolveResult:
     """Solve a batch with periodic checkpoints; resume if one exists.
 
     Semantics match ops.solver.solve_batch (without compaction — chunk
     boundaries replace it as the long-tail control point). The checkpoint is
-    deleted on completion unless ``keep_checkpoint``.
+    deleted on completion unless ``keep_checkpoint``. A checkpoint records
+    the request batch's sha256 and refuses to resume different boards.
+
+    ``sharding``: optional jax.sharding.NamedSharding for the batch axis —
+    the whole search state (every leaf is batch-leading) fans out across the
+    mesh, and a resumed state is re-placed the same way.
     """
     grid = np.asarray(grid, np.int32)
     if spec is None:
         spec = spec_for_size(grid.shape[-1])
+    fingerprint = boards_fingerprint(grid)
 
     if os.path.exists(checkpoint_path):
-        state, ck_spec = load_solver_state(checkpoint_path)
+        state, ck_spec, ck_hash = load_solver_state(checkpoint_path)
         if ck_spec != spec:
             raise ValueError(
                 f"checkpoint at {checkpoint_path} is for a "
@@ -122,8 +162,28 @@ def solve_batch_resumable(
                 f"checkpoint batch {state.grid.shape[0]} != request batch "
                 f"{grid.shape[0]}"
             )
+        if ck_hash is not None and not np.array_equal(ck_hash, fingerprint):
+            raise ValueError(
+                f"checkpoint at {checkpoint_path} belongs to a different "
+                f"board batch — refusing to resume (delete the stale "
+                f"snapshot or use a distinct path per batch)"
+            )
     else:
         state = S.init_state(jax.numpy.asarray(grid), spec, max_depth)
+
+    if sharding is not None:
+        # batch-axis placement for every array leaf; the scalar iteration
+        # counter is replicated (a PartitionSpec shorter than the rank
+        # leaves trailing dims replicated)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = NamedSharding(sharding.mesh, P())
+        state = jax.tree.map(
+            lambda x: jax.device_put(
+                x, sharding if getattr(x, "ndim", 0) else replicated
+            ),
+            state,
+        )
 
     while True:
         state = jax.block_until_ready(
@@ -132,7 +192,7 @@ def solve_batch_resumable(
         done = not bool(np.asarray(state.status == S.RUNNING).any())
         if done or int(state.iters) >= max_iters:
             break
-        save_solver_state(checkpoint_path, state, spec)
+        save_solver_state(checkpoint_path, state, spec, fingerprint)
 
     state = S.finalize_status(state, spec)
     if not keep_checkpoint and os.path.exists(checkpoint_path):

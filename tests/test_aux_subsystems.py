@@ -28,7 +28,7 @@ from sudoku_solver_distributed_tpu.utils.profiling import (
 # -- checkpoint / resume ----------------------------------------------------
 
 def test_resumable_matches_direct(tmp_path):
-    boards = generate_batch(16, 52, seed=42)
+    boards = generate_batch(16, 52, seed=42, unique=True)
     ck = str(tmp_path / "solve.npz")
     res = solve_batch_resumable(boards, SPEC_9, checkpoint_path=ck, chunk_iters=8)
     direct = solve_batch(np.asarray(boards), SPEC_9)
@@ -40,7 +40,7 @@ def test_resumable_matches_direct(tmp_path):
 def test_resume_from_snapshot_bitexact(tmp_path):
     """Interrupt after the first chunk; a fresh driver must resume from the
     snapshot and produce the same solution as an uninterrupted run."""
-    boards = generate_batch(8, 56, seed=43)
+    boards = generate_batch(8, 56, seed=43, unique=True)
     ck = str(tmp_path / "interrupted.npz")
 
     # simulate the interrupted first run: one chunk, then snapshot (what the
@@ -72,8 +72,9 @@ def test_checkpoint_roundtrip_and_validation(tmp_path):
     state = S.init_state(jnp.asarray(boards), SPEC_9, 16)
     path = str(tmp_path / "state.npz")
     save_solver_state(path, state, SPEC_9)
-    loaded, spec = load_solver_state(path)
+    loaded, spec, boards_hash = load_solver_state(path)
     assert spec == SPEC_9
+    assert boards_hash is None  # save without a fingerprint stays loadable
     for f in state._fields:
         np.testing.assert_array_equal(
             np.asarray(getattr(state, f)), np.asarray(getattr(loaded, f))
@@ -130,3 +131,107 @@ def test_device_trace_writes_profile(tmp_path):
 def test_device_trace_none_is_noop():
     with device_trace(None):
         pass  # must not require jax or create anything
+
+
+def test_engine_resumable_survives_sigkill(tmp_path):
+    """A SIGKILLed engine solve resumes bit-exact from its snapshot through
+    the engine path (VERDICT r1 #8): child process solves with tiny chunks,
+    parent kills it once a checkpoint lands, then a fresh engine run with the
+    same path must finish from the snapshot and match the direct solve."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # unique=True: with multi-solution boards the compacted/widened direct
+    # path could legally find a different solution than the chunked path,
+    # and the bit-exact comparison below would flag a correct solver
+    boards = generate_batch(8, 58, seed=77, unique=True)
+    np.save(tmp_path / "boards.npy", np.asarray(boards))
+    ck = str(tmp_path / "engine_solve.npz")
+
+    child_src = f"""
+import numpy as np
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+boards = np.load({str(tmp_path / 'boards.npy')!r})
+eng = SolverEngine(buckets=(8,))
+eng.solve_batch_resumable_np(
+    boards, {ck!r}, chunk_iters=4, keep_checkpoint=True
+)
+print("child finished", flush=True)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep the child off the TPU tunnel
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src],
+        cwd=repo,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.time() + 180
+        while not os.path.exists(ck) and time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "child finished before a checkpoint landed — raise "
+                    "difficulty or shrink chunk_iters:\n" + proc.stdout.read()
+                )
+            time.sleep(0.02)
+        assert os.path.exists(ck), "no checkpoint within deadline"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # resume purely from disk, through a fresh engine
+    eng = SolverEngine(buckets=(8,))
+    solutions, solved_mask, info = eng.solve_batch_resumable_np(
+        boards, ck, chunk_iters=64
+    )
+    assert bool(solved_mask.all())
+    assert not os.path.exists(ck)  # cleaned up on completion
+    direct = solve_batch(np.asarray(boards), SPEC_9)
+    np.testing.assert_array_equal(solutions, np.asarray(direct.grid))
+    assert eng.solved_puzzles == 8 and eng.validations == info["validations"] > 0
+
+
+def test_resumable_refuses_stale_checkpoint(tmp_path):
+    """A snapshot resumed against a *different* same-shape batch must raise,
+    not silently return the old batch's solutions."""
+    ck = str(tmp_path / "stale.npz")
+    batch_a = generate_batch(4, 56, seed=101)
+    batch_b = generate_batch(4, 56, seed=102)
+    solve_batch_resumable(
+        batch_a, SPEC_9, checkpoint_path=ck, chunk_iters=4,
+        keep_checkpoint=True,
+    )
+    assert os.path.exists(ck)
+    with pytest.raises(ValueError, match="different board batch"):
+        solve_batch_resumable(batch_b, SPEC_9, checkpoint_path=ck)
+
+
+def test_resumable_sharded_over_mesh(tmp_path):
+    """The resumable driver fans the whole search state over the mesh when
+    given the engine's batch sharding (every state leaf is batch-leading)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sudoku_solver_distributed_tpu.parallel import default_mesh
+
+    mesh = default_mesh()
+    sharding = NamedSharding(mesh, P("data"))
+    boards = generate_batch(16, 54, seed=103, unique=True)
+    ck = str(tmp_path / "sharded.npz")
+    res = solve_batch_resumable(
+        boards, SPEC_9, checkpoint_path=ck, chunk_iters=8, sharding=sharding
+    )
+    assert bool(np.asarray(res.solved).all())
+    direct = solve_batch(np.asarray(boards), SPEC_9)
+    np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(direct.grid))
