@@ -3,6 +3,20 @@
 Run (needs the tunneled chip): python benchmarks/exp_pallas.py
 (sys.path bootstrap below — PYTHONPATH breaks this environment's TPU
 plugin discovery, so don't set it.)
+
+Status 2026-07-29 (round 2): the transposed-layout kernel lowers through
+Mosaic cleanly (no more "unsupported shape cast"), but this environment
+cannot finish the TPU compile for Pallas custom-calls:
+  * remote compile (PALLAS_AXON_REMOTE_COMPILE=1): the terminal-side
+    tpu_compile_helper exits 1 — its env carries literal warning text in
+    TPU_ACCELERATOR_TYPE/TPU_WORKER_HOSTNAMES ("Failed to find host bounds
+    for accelerator type: WARNING: could not determine ..."); the helper
+    runs env_clear'd server-side, so no client env can fix it.
+  * client AOT (PALLAS_AXON_REMOTE_COMPILE=0): refused on a libtpu build
+    mismatch (terminal cl/831091709 Nov 12 2025 vs client cl/854318611
+    Jan 12 2026); no matching libtpu exists in the image.
+Plain XLA programs are unaffected (bench.py compiles and runs). When the
+infra allows Mosaic custom-calls, this script produces the comparison.
 """
 
 import os
@@ -18,7 +32,10 @@ import numpy as np
 from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
 from sudoku_solver_distributed_tpu.ops.pallas_solver import solve_batch_pallas
 
-boards = np.load("/root/repo/benchmarks/corpus_9x9_hard_16384.npz")["boards"]
+boards = np.load(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "corpus_9x9_hard_16384.npz")
+)["boards"]
 dev = jnp.asarray(boards)
 B = dev.shape[0]
 
@@ -51,4 +68,4 @@ for block in (128, 256, 512):
             flush=True,
         )
     except Exception as e:
-        print(f"pallas b={block}: FAIL {type(e).__name__}: {str(e)[:200]}")
+        print(f"pallas b={block}: FAIL {type(e).__name__}: {str(e)[:300]}")

@@ -48,6 +48,10 @@ class SolverEngine:
         (reference node.py:427-475).
       frontier_states_per_device: speculative states seeded per chip for the
         frontier race.
+      backend: batch kernel implementation — "xla" (ops/solver.py, the
+        compacted lockstep loop; default) or "pallas" (ops/pallas_solver.py,
+        the VMEM-resident per-block kernel; interpret mode is selected
+        automatically off-TPU so tests run anywhere).
     """
 
     def __init__(
@@ -59,13 +63,26 @@ class SolverEngine:
         sharding: Optional[jax.sharding.Sharding] = None,
         frontier_mesh: Optional[jax.sharding.Mesh] = None,
         frontier_states_per_device: int = 64,
+        backend: str = "xla",
     ):
+        if backend not in ("xla", "pallas"):
+            raise ValueError(f"unknown engine backend {backend!r}")
+        if backend == "pallas" and sharding is not None:
+            # pallas_call has no GSPMD partitioning rule: the sharded bucket
+            # would either fail to compile or silently replicate onto every
+            # chip. Mesh fan-out for the pallas kernel needs a shard_map
+            # wrapper (ROADMAP); refuse rather than mislead.
+            raise ValueError(
+                "backend='pallas' does not compose with sharding= — use the "
+                "xla backend for mesh-sharded buckets"
+            )
         self.spec = spec
         self.buckets = tuple(sorted(set(buckets)))
         self.max_depth = max_depth
         self.sharding = sharding
         self.frontier_mesh = frontier_mesh
         self.frontier_states_per_device = frontier_states_per_device
+        self.backend = backend
         # when set, batch device calls are captured as jax.profiler traces
         # under this directory (utils/profiling.py; CLI --profile-dir); only
         # one trace can be active per process, so concurrent requests skip
@@ -80,8 +97,22 @@ class SolverEngine:
         self.solved_puzzles = 0
 
         def _run(grid):
-            res = solve_batch(grid, self.spec, max_depth=self.max_depth)
             B = grid.shape[0]
+            if self.backend == "pallas":
+                from .ops.pallas_solver import solve_batch_pallas
+
+                # block is a lane width: always 128 on TPU (Mosaic tiling —
+                # the kernel pads small buckets up to a block multiple);
+                # interpret mode matches so both paths run the same shapes
+                res = solve_batch_pallas(
+                    grid,
+                    self.spec,
+                    block=128,
+                    max_depth=self.max_depth,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            else:
+                res = solve_batch(grid, self.spec, max_depth=self.max_depth)
             # Pack every result field into ONE int32 array: the serving path
             # pays exactly one device→host transfer per request. (Unpacked,
             # each field is its own transfer — at ~70 ms RTT over a tunneled

@@ -87,6 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--num-hosts", type=int, default=1)
     parser.add_argument("--host-id", type=int, default=0)
     parser.add_argument(
+        "--backend",
+        default="xla",
+        choices=["xla", "pallas"],
+        help="engine batch kernel: the XLA compacted lockstep solver "
+        "(default) or the VMEM-resident pallas kernel",
+    )
+    parser.add_argument(
         "--frontier",
         type=int,
         default=0,
@@ -147,7 +154,7 @@ def main(argv=None) -> None:
     from ..engine import SolverEngine
     from ..ops import spec_for_size
 
-    kwargs = {"spec": spec_for_size(args.board_size)}
+    kwargs = {"spec": spec_for_size(args.board_size), "backend": args.backend}
     if args.buckets:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
     if args.frontier > 0:

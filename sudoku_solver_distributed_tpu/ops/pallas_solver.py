@@ -11,12 +11,26 @@ Block-granular early exit replaces hierarchical compaction (only the block
 containing the hardest board runs long), and the iteration loop touches HBM
 exactly twice per block (load boards, store results).
 
+Layout (the part Mosaic dictates): **boards ride the 128-wide lane axis,
+cells ride sublanes** — state is ``(C_pad, block)`` int32, cell-major — so
+every per-board quantity is a ``(1, block)`` vector, every per-cell op is
+elementwise, and all cross-cell reductions run along sublanes. No reshape
+between board-2D and flat views ever happens inside the kernel (the
+flat↔(N,N) casts of a board-major layout are exactly what Mosaic's
+``infer-vector-layout`` rejects).
+
+Unit constraints ride the **MXU**: with cells on sublanes, "how many cells
+of unit u hold/admit value v" is one matmul — ``counts = U @ planes`` where
+``U`` is the constant (3N, C) unit-incidence matrix and ``planes`` the
+(C, V·block) candidate/value bitplanes — and scattering a per-unit verdict
+back to cells is the transpose matmul. Four small dots per sweep replace
+all histogramming; counts ≤ C fit float32 exactly.
+
 Semantics mirror ops/solver.py ``_step`` exactly: fused naked+hidden-singles
-analysis, MRV branching, explicit-stack backtracking, the same
-RUNNING/SOLVED/UNSAT/OVERFLOW status lanes and guesses/validations
-accounting. Everything is formulated gather/scatter-free (mask-selects over
-statically-indexed axes) because Mosaic vectorizes those directly; VMEM
-budget per block at the defaults (block=256, max_depth=32, 9×9) is ~7 MB.
+analysis (ops/propagate.analyze), MRV branching with lowest-index/lowest-bit
+tie-breaks, explicit-stack backtracking, the same RUNNING/SOLVED/UNSAT/
+OVERFLOW status lanes and guesses/validations accounting — property-tested
+against the XLA path (tests/test_ops_pallas.py).
 
 The reference has no analog — this is the innermost replacement for its
 per-cell Python probe (reference node.py:76-116), one more level down the
@@ -25,125 +39,152 @@ TPU stack than the XLA kernel.
 
 from __future__ import annotations
 
-import functools
+from functools import lru_cache
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .spec import BoardSpec
 from .solver import OVERFLOW, RUNNING, SOLVED, UNSAT, SolveResult
 
+_BIG = 1 << 30  # plain int: jnp scalars would be captured closure constants
 
-from .encode import mask_to_value as _mask_value  # pure lax ops: kernel-safe
+
+def _pad8(n: int) -> int:
+    return -(-n // 8) * 8
 
 
-def _analyze_block(g, spec: BoardSpec):
-    """In-kernel fused analysis of a (BLK, C) int32 block.
+@lru_cache(maxsize=None)
+def _unit_matrices(spec: BoardSpec):
+    """(U, UT): the (3N_pad, C_pad) unit-incidence matrix and its transpose.
 
-    Returns (cand (BLK,C), assign (BLK,C), contradiction (BLK,), solved
-    (BLK,)) with the same semantics as ops/propagate.analyze. Static unrolls
-    over units/values keep it gather-free.
+    U[u, c] = 1 iff cell c belongs to unit u (rows 0..N-1: rows of the
+    board; N..2N-1: columns; 2N..3N-1: boxes). float32 so the kernel's
+    ``counts = U @ planes`` dots run on the MXU with exact small-integer
+    arithmetic.
     """
     n, N, C = spec.box, spec.size, spec.cells
-    BLK = g.shape[0]
-    full = jnp.int32(spec.full_mask)
-    gm = g.reshape(BLK, N, N)
-    vb = jnp.where(
-        gm > 0, jax.lax.shift_left(jnp.int32(1), gm - 1), jnp.int32(0)
-    )
+    UP, CP = _pad8(3 * N), _pad8(C)
+    U = np.zeros((UP, CP), np.float32)
+    for c in range(C):
+        i, j = divmod(c, N)
+        U[i, c] = 1.0
+        U[N + j, c] = 1.0
+        U[2 * N + (i // n) * n + (j // n), c] = 1.0
+    return U, np.ascontiguousarray(U.T)
 
-    # used-value masks per unit: OR over the unit's cells (static unroll)
-    row_used = functools.reduce(
-        jnp.bitwise_or, [vb[:, :, j] for j in range(N)]
-    )  # (BLK, N)
-    col_used = functools.reduce(
-        jnp.bitwise_or, [vb[:, i, :] for i in range(N)]
-    )  # (BLK, N)
-    vbb = vb.reshape(BLK, n, n, n, n)
-    box_used = functools.reduce(
-        jnp.bitwise_or,
-        [vbb[:, :, ii, :, jj] for ii in range(n) for jj in range(n)],
-    )  # (BLK, n, n)
 
-    # duplicate in a unit ⟺ distinct values < filled cells
-    fill = (gm > 0).astype(jnp.int32)
-    row_fill = fill.sum(axis=2)
-    col_fill = fill.sum(axis=1)
-    box_fill = (
-        fill.reshape(BLK, n, n, n, n).sum(axis=4).sum(axis=2)
-    )  # (BLK, n, n)
-    pc = jax.lax.population_count
-    dup = (
-        (pc(row_used) < row_fill).any(axis=1)
-        | (pc(col_used) < col_fill).any(axis=1)
-        | (pc(box_used) < box_fill).reshape(BLK, n * n).any(axis=1)
-    )
-    solved = (
-        (pc(row_used) == N).all(axis=1)
-        & (pc(col_used) == N).all(axis=1)
-        & (pc(box_used) == N).reshape(BLK, n * n).all(axis=1)
-    )
+def _val_of(mask, spec: BoardSpec):
+    """Value 1..N of a ≤1-bit mask (0 for empty mask), popcount-free:
+    Σ (v+1)·bit_v — elementwise, any shape."""
+    out = jnp.zeros_like(mask)
+    for v in range(spec.size):
+        out = out + (v + 1) * ((mask >> v) & 1)
+    return out
 
-    used = (
-        row_used[:, :, None]
-        | col_used[:, None, :]
-        | jnp.broadcast_to(
-            box_used[:, :, None, :, None], (BLK, n, n, n, n)
-        ).reshape(BLK, N, N)
-    )
-    empty = gm == 0
-    cand = jnp.where(empty, ~used & full, jnp.int32(0))
 
-    # hidden singles, unrolled per value: a (unit, value) with exactly one
-    # admitting cell forces that cell
-    hidden = jnp.zeros((BLK, N, N), jnp.int32)
-    for v in range(N):
-        m = jax.lax.shift_right_logical(cand, v) & 1  # (BLK, N, N) 0/1
-        rc = m.sum(axis=2)                             # row admit counts
-        cc = m.sum(axis=1)
-        bc = m.reshape(BLK, n, n, n, n).sum(axis=4).sum(axis=2)  # (BLK,n,n)
-        one = (
-            (rc[:, :, None] == 1)
-            | (cc[:, None, :] == 1)
-            | (
-                jnp.broadcast_to(
-                    bc[:, :, None, :, None] == 1, (BLK, n, n, n, n)
-                ).reshape(BLK, N, N)
+def _make_kernel(spec: BoardSpec, L: int, D: int, max_iters: int):
+    """Kernel over one block: g_ref (C_pad, L) int32 boards (cell-major),
+    U/UT refs, outputs grid (C_pad, L) and meta (8, L) int32
+    (status/guesses/validations/iters rows).
+
+    ``D`` is the caller's true depth cap (OVERFLOW threshold, matching the
+    XLA path exactly); the stack allocates DP = pad8(D) frames so the depth
+    axis meets Mosaic's sublane granularity, with the pad frames unreachable.
+    """
+    n, N, C = spec.box, spec.size, spec.cells
+    CP, UP = _pad8(C), _pad8(3 * N)
+    DP = _pad8(D)
+    full = spec.full_mask  # plain int; wrapped per-use inside the trace
+
+    def kernel(g_ref, u_ref, ut_ref, grid_out, meta_out):
+        U = u_ref[:]            # (UP, CP) f32
+        UT = ut_ref[:]          # (CP, UP) f32
+        iota_c = jax.lax.broadcasted_iota(jnp.int32, (CP, L), 0)
+        iota_d = jax.lax.broadcasted_iota(jnp.int32, (DP, L), 0)
+        valid = (iota_c < C).astype(jnp.int32)          # (CP, L) real cells
+
+        def planes_of(x):
+            """(CP, L) bitmask → (CP, V·L) f32 bitplanes, lane-major per
+            value (plane v occupies lanes v·L..(v+1)·L)."""
+            return jnp.concatenate(
+                [((x >> v) & 1).astype(jnp.float32) for v in range(N)],
+                axis=1,
             )
-        )
-        hidden = hidden | jnp.where(
-            (m == 1) & one, jnp.int32(1 << v), jnp.int32(0)
-        )
 
-    naked = pc(cand) == 1
-    assign = jnp.where(naked, cand, hidden)
-    assign = assign & -assign
+        def unplane(p, weight=None):
+            """(CP, V·L) 0/1 f32 → (CP, L) int32 bitmask (or weighted sum)."""
+            out = jnp.zeros((CP, L), jnp.int32)
+            for v in range(N):
+                bit = p[:, v * L : (v + 1) * L].astype(jnp.int32)
+                out = out + (bit << v if weight is None else bit * weight(v))
+            return out
 
-    dead = (empty & (cand == 0)).any(axis=(1, 2))
-    bad = ((gm < 0) | (gm > N)).any(axis=(1, 2))
-    return (
-        cand.reshape(BLK, C),
-        assign.reshape(BLK, C),
-        dup | dead | bad,
-        solved,
-    )
+        def analyze(g):
+            """Mirror of ops/propagate.analyze in the transposed layout.
+            Returns (cand (CP,L), assign (CP,L), contra (1,L), solved (1,L),
+            pc_cand (CP,L)) — flags as int32 0/1 vectors."""
+            in_range = ((g >= 1) & (g <= N)).astype(jnp.int32) * valid
+            shift = jnp.clip(g - 1, 0, 31)
+            vmask = jnp.where(in_range == 1, jnp.int32(1) << shift, 0)
 
-
-def _make_kernel(spec: BoardSpec, BLK: int, D: int, max_iters: int):
-    C = spec.cells
-
-    def kernel(g_ref, grid_out, status_out, guesses_out, vals_out, iters_out):
-        iota_c = jax.lax.broadcasted_iota(jnp.int32, (BLK, C), 1)
-        iota_d = jax.lax.broadcasted_iota(jnp.int32, (BLK, D), 1)
-
-        def sel_d(arr, idx):
-            """arr (BLK, D) picked at per-board idx (BLK, 1) → (BLK,)."""
-            return jnp.sum(
-                jnp.where(iota_d == idx, arr, jnp.zeros_like(arr)), axis=1
+            vplanes = planes_of(vmask)                 # (CP, V·L)
+            counts = jnp.dot(
+                U, vplanes, preferred_element_type=jnp.float32
+            )                                          # (UP, V·L)
+            # used[c,v]: some unit of c already holds v
+            used_cv = jnp.dot(
+                UT, (counts > 0).astype(jnp.float32),
+                preferred_element_type=jnp.float32,
             )
+            used = unplane((used_cv > 0).astype(jnp.float32))
+            # dup: any (unit, value) count > 1, folded to (1, L)
+            gt1 = (counts > 1).astype(jnp.int32)       # (UP, V·L)
+            dup_u = jnp.zeros((UP, L), jnp.int32)
+            for v in range(N):
+                dup_u = dup_u | gt1[:, v * L : (v + 1) * L]
+            dup = (jnp.sum(dup_u, axis=0, keepdims=True) > 0).astype(
+                jnp.int32
+            )
+
+            empty = ((g == 0).astype(jnp.int32)) * valid
+            cand = jnp.where(empty == 1, ~used & full, 0)
+
+            cplanes = planes_of(cand)
+            ccounts = jnp.dot(
+                U, cplanes, preferred_element_type=jnp.float32
+            )
+            exact1 = (ccounts == 1).astype(jnp.float32)
+            backmap = jnp.dot(
+                UT, exact1, preferred_element_type=jnp.float32
+            )                                          # (CP, V·L)
+            hidden = unplane(
+                ((backmap > 0).astype(jnp.float32)) * cplanes
+            )
+            pc_cand = unplane(cplanes, weight=lambda v: 1)
+
+            naked = (pc_cand == 1).astype(jnp.int32)
+            assign = jnp.where(naked == 1, cand, hidden)
+            assign = assign & -assign
+
+            dead = (
+                jnp.sum(empty * (cand == 0).astype(jnp.int32), axis=0,
+                        keepdims=True) > 0
+            ).astype(jnp.int32)
+            bad = (
+                jnp.sum(((g < 0) | (g > N)).astype(jnp.int32) * valid,
+                        axis=0, keepdims=True) > 0
+            ).astype(jnp.int32)
+            filled = (
+                jnp.sum(empty, axis=0, keepdims=True) == 0
+            ).astype(jnp.int32)
+            solved = filled * (1 - dup) * (1 - bad)
+            contra = dup | dead | bad
+            return cand, assign, contra, solved, pc_cand
 
         def cond(carry):
             (g, sg, sc, sm, depth, status, guesses, vals, it) = carry
@@ -151,118 +192,120 @@ def _make_kernel(spec: BoardSpec, BLK: int, D: int, max_iters: int):
 
         def body(carry):
             (g, sg, sc, sm, depth, status, guesses, vals, it) = carry
-            cand, assign, contra, solved = _analyze_block(g, spec)
-            running = status[:, 0] == RUNNING
+            cand, assign, contra, solved, pc_cand = analyze(g)
+            running = (status == RUNNING).astype(jnp.int32)   # (1, L)
 
-            status1 = jnp.where(running & solved, SOLVED, status[:, 0])
-            act = running & ~solved
+            status1 = jnp.where(
+                (running * solved) == 1, SOLVED, status
+            )
+            act = running * (1 - solved)
 
             # path 1: assign all forced singles
-            has_single = (assign != 0).any(axis=1)
-            do_assign = act & ~contra & has_single
-            assigned = jnp.where(assign != 0, _mask_value(assign), g)
+            has_single = (
+                jnp.sum((assign != 0).astype(jnp.int32), axis=0,
+                        keepdims=True) > 0
+            ).astype(jnp.int32)
+            do_assign = act * (1 - contra) * has_single       # (1, L)
+            assigned = jnp.where(assign != 0, _val_of(assign, spec), g)
 
-            # path 2: branch on the MRV cell
-            do_branch = act & ~contra & ~has_single
-            key = jnp.where(
-                g == 0, jax.lax.population_count(cand), jnp.int32(1 << 30)
-            )
-            # integer argmin (Mosaic has no int argmin): min value, then the
-            # lowest cell index attaining it
-            min_key = jnp.min(key, axis=1, keepdims=True)     # (BLK, 1)
+            # path 2: branch on the MRV cell (lowest index on ties)
+            do_branch = act * (1 - contra) * (1 - has_single)
+            empty_now = ((g == 0).astype(jnp.int32)) * valid
+            key = jnp.where(empty_now == 1, pc_cand, _BIG)
+            min_key = jnp.min(key, axis=0, keepdims=True)     # (1, L)
             cell = jnp.min(
-                jnp.where(key == min_key, iota_c, jnp.int32(1 << 30)), axis=1
-            )                                                  # (BLK,)
-            cell_hot = iota_c == cell[:, None]                # (BLK, C)
-            mrv_mask = jnp.sum(jnp.where(cell_hot, cand, 0), axis=1)
+                jnp.where(key == min_key, iota_c, _BIG), axis=0,
+                keepdims=True,
+            )                                                 # (1, L)
+            cell_hot = (iota_c == cell).astype(jnp.int32)     # (CP, L)
+            mrv_mask = jnp.sum(cell_hot * cand, axis=0, keepdims=True)
             guess_bit = mrv_mask & -mrv_mask
-            overflow = do_branch & (depth[:, 0] >= D)
-            do_branch = do_branch & (depth[:, 0] < D)
-            status1 = jnp.where(overflow, OVERFLOW, status1)
-            gval = _mask_value(guess_bit)                     # (BLK,)
-            branched = jnp.where(cell_hot, gval[:, None], g)
+            overflow = do_branch * (depth >= D).astype(jnp.int32)
+            do_branch = do_branch * (depth < D).astype(jnp.int32)
+            status1 = jnp.where(overflow == 1, OVERFLOW, status1)
+            gval = _val_of(guess_bit, spec)                   # (1, L)
+            branched = jnp.where(
+                (cell_hot * do_branch) == 1, gval, g
+            )
 
             # path 3: backtrack
-            do_bt = act & contra
-            top = jnp.clip(depth - 1, 0, D - 1)               # (BLK, 1)
-            top_hot = iota_d == top                           # (BLK, D)
-            top_mask = sel_d(sm, top)
-            top_cell = sel_d(sc, top)
+            do_bt = act * contra                              # (1, L)
+            top = jnp.clip(depth - 1, 0, D - 1)               # (1, L)
+            top_hot = (iota_d == top).astype(jnp.int32)       # (D, L)
+            top_mask = jnp.sum(top_hot * sm, axis=0, keepdims=True)
+            top_cell = jnp.sum(top_hot * sc, axis=0, keepdims=True)
             top_grid = jnp.sum(
-                jnp.where(top_hot[:, :, None], sg, jnp.int8(0)).astype(
+                jnp.where(top_hot[:, None, :] == 1, sg, jnp.int8(0)).astype(
                     jnp.int32
                 ),
-                axis=1,
-            )                                                  # (BLK, C)
-            empty_stack = depth[:, 0] == 0
-            exhausted = top_mask == 0
-            bt_pop = do_bt & ~empty_stack & exhausted
-            bt_retry = do_bt & ~empty_stack & ~exhausted
+                axis=0,
+            )                                                 # (CP, L)
+            empty_stack = (depth == 0).astype(jnp.int32)
+            exhausted = (top_mask == 0).astype(jnp.int32)
+            bt_pop = do_bt * (1 - empty_stack) * exhausted
+            bt_retry = do_bt * (1 - empty_stack) * (1 - exhausted)
             retry_bit = top_mask & -top_mask
-            tc_hot = iota_c == top_cell[:, None]
+            tc_hot = (iota_c == top_cell).astype(jnp.int32)
             retry_grid = jnp.where(
-                tc_hot, _mask_value(retry_bit)[:, None], top_grid
+                tc_hot == 1, _val_of(retry_bit, spec), top_grid
             )
-            status1 = jnp.where(do_bt & empty_stack, UNSAT, status1)
+            status1 = jnp.where((do_bt * empty_stack) == 1, UNSAT, status1)
 
             # merge grids
             g1 = g
-            g1 = jnp.where(do_assign[:, None], assigned, g1)
-            g1 = jnp.where(do_branch[:, None], branched, g1)
-            g1 = jnp.where(bt_retry[:, None], retry_grid, g1)
+            g1 = jnp.where(do_assign == 1, assigned, g1)
+            g1 = jnp.where(do_branch == 1, branched, g1)
+            g1 = jnp.where(bt_retry == 1, retry_grid, g1)
 
-            # stack updates (mask-select on the D axis)
-            push_slot = jnp.clip(depth, 0, D - 1)             # (BLK, 1)
-            push_hot = (iota_d == push_slot) & do_branch[:, None]
-            sg1 = jnp.where(push_hot[:, :, None], g[:, None, :].astype(jnp.int8), sg)
-            sc1 = jnp.where(push_hot, cell[:, None], sc)
+            # stack updates (mask-select on the depth axis)
+            push_slot = jnp.clip(depth, 0, D - 1)             # (1, L)
+            push_hot = (iota_d == push_slot).astype(jnp.int32) * do_branch
+            sg1 = jnp.where(
+                push_hot[:, None, :] == 1, g.astype(jnp.int8)[None], sg
+            )
+            sc1 = jnp.where(push_hot == 1, cell, sc)
             pushed_mask = mrv_mask & ~guess_bit
-            sm1 = jnp.where(push_hot, pushed_mask[:, None], sm)
-            retry_hot = top_hot & bt_retry[:, None]
-            sm1 = jnp.where(retry_hot, (top_mask & ~retry_bit)[:, None], sm1)
+            sm1 = jnp.where(push_hot == 1, pushed_mask, sm)
+            retry_hot = top_hot * bt_retry
+            sm1 = jnp.where(retry_hot == 1, top_mask & ~retry_bit, sm1)
 
-            depth1 = depth + (
-                do_branch.astype(jnp.int32) - bt_pop.astype(jnp.int32)
-            )[:, None]
+            depth1 = depth + do_branch - bt_pop
             return (
-                g1,
-                sg1,
-                sc1,
-                sm1,
-                depth1,
-                status1[:, None],
-                guesses + do_branch.astype(jnp.int32)[:, None],
-                vals + running.astype(jnp.int32)[:, None],
+                g1, sg1, sc1, sm1, depth1, status1,
+                guesses + do_branch,
+                vals + running,
                 it + 1,
             )
 
-        g0 = g_ref[:]
+        g0 = g_ref[:].astype(jnp.int32)
         init = (
             g0,
-            jnp.zeros((BLK, D, C), jnp.int8),
-            jnp.zeros((BLK, D), jnp.int32),
-            jnp.zeros((BLK, D), jnp.int32),
-            jnp.zeros((BLK, 1), jnp.int32),
-            jnp.full((BLK, 1), RUNNING, jnp.int32),
-            jnp.zeros((BLK, 1), jnp.int32),
-            jnp.zeros((BLK, 1), jnp.int32),
+            jnp.zeros((DP, CP, L), jnp.int8),
+            jnp.zeros((DP, L), jnp.int32),
+            jnp.zeros((DP, L), jnp.int32),
+            jnp.zeros((1, L), jnp.int32),
+            jnp.full((1, L), RUNNING, jnp.int32),
+            jnp.zeros((1, L), jnp.int32),
+            jnp.zeros((1, L), jnp.int32),
             jnp.int32(0),
         )
-        (g, sg, sc, sm, depth, status, guesses, vals, it) = jax.lax.while_loop(
-            cond, body, init
+        (g, sg, sc, sm, depth, status, guesses, vals, it) = (
+            jax.lax.while_loop(cond, body, init)
         )
         # close the last-step gap exactly like solver.finalize_status
-        _, _, _, solved = _analyze_block(g, spec)
+        _, _, _, solved, _ = analyze(g)
         status = jnp.where(
-            (status[:, 0] == RUNNING) & solved, SOLVED, status[:, 0]
-        )[:, None]
+            (status == RUNNING) & (solved == 1), SOLVED, status
+        )
         grid_out[:] = g
-        status_out[:] = status
-        guesses_out[:] = guesses
-        vals_out[:] = vals
-        # per-board lane (a (1,1)-blocked SMEM scalar fails Mosaic's
-        # (8,128)-divisibility rule); reduced with max() host-side
-        iters_out[:] = jnp.full((BLK, 1), it, jnp.int32)
+        meta_out[:] = jnp.concatenate(
+            [
+                status, guesses, vals,
+                jnp.full((1, L), it, jnp.int32),
+                jnp.zeros((4, L), jnp.int32),
+            ],
+            axis=0,
+        )
 
     return kernel
 
@@ -271,7 +314,7 @@ def solve_batch_pallas(
     grid: jnp.ndarray,
     spec: BoardSpec,
     *,
-    block: int = 256,
+    block: int = 128,
     max_depth: Optional[int] = None,
     max_iters: int = 4096,
     interpret: bool = False,
@@ -280,56 +323,67 @@ def solve_batch_pallas(
 
     Functionally equivalent to ops.solver.solve_batch (same statuses, same
     solutions; iteration counts differ — here ``iters`` is the max over
-    blocks). B is padded up to a multiple of ``block`` with empty boards.
+    blocks). B is padded up to a multiple of ``block`` with contradictory
+    boards (UNSAT in one step, so a mostly-pad block exits immediately).
+
+    ``block`` is the lane width of one kernel instance: on real TPU it must
+    be a multiple of 128 (Mosaic lane tiling); interpret mode takes any
+    value.
     """
     B = grid.shape[0]
-    C = spec.cells
-    # Degenerate near-empty boards genuinely use ~C*0.6 guess frames (an
-    # empty 9×9 takes 47); 64 covers every 9×9 while keeping the block's
-    # stack ~1.3 MB of VMEM at the default block size.
-    D = max_depth if max_depth is not None else min(spec.max_depth, 64)
+    N, C = spec.size, spec.cells
+    CP = _pad8(C)
+    # Same default depth budget as the XLA path (spec.max_depth) so the two
+    # backends report identical OVERFLOW verdicts. The per-block VMEM stack
+    # is D×C_pad×block int8 — ~1 MB for 9×9, ~8 MB for 16×16; for 25×25
+    # (~50 MB) pass an explicit smaller max_depth.
+    D = max_depth if max_depth is not None else spec.max_depth
     flat = grid.astype(jnp.int32).reshape(B, C)
     pad = (-B) % block
     if pad:
-        # pad with trivially contradictory boards (two equal clues in row 0):
-        # they go UNSAT in one iteration, so a mostly-pad block exits
-        # immediately — an empty-board pad would be the *deepest* 9×9 search
         pad_board = jnp.zeros((C,), jnp.int32).at[0].set(1).at[1].set(1)
         flat = jnp.concatenate(
             [flat, jnp.broadcast_to(pad_board, (pad, C))], axis=0
         )
-    nblocks = flat.shape[0] // block
+    BP = flat.shape[0]
+    nblocks = BP // block
+    # cell-major: (CP, BP), boards on lanes
+    cells_major = jnp.zeros((CP, BP), jnp.int32).at[:C].set(flat.T)
+
+    U, UT = _unit_matrices(spec)
+    UPAD = U.shape[0]
 
     kernel = _make_kernel(spec, block, D, max_iters)
-    outs = pl.pallas_call(
+    grid_cm, meta = pl.pallas_call(
         kernel,
         grid=(nblocks,),
         out_shape=(
-            jax.ShapeDtypeStruct(flat.shape, jnp.int32),
-            jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
-            jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
-            jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
-            jax.ShapeDtypeStruct((flat.shape[0], 1), jnp.int32),
+            jax.ShapeDtypeStruct((CP, BP), jnp.int32),
+            jax.ShapeDtypeStruct((8, BP), jnp.int32),
         ),
         in_specs=[
-            pl.BlockSpec((block, C), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec((CP, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((UPAD, CP), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((CP, UPAD), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((block, C), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((CP, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
-    )(flat)
-    grids, status, guesses, vals, iters = outs
-    N = spec.size
+    )(cells_major, jnp.asarray(U), jnp.asarray(UT))
+
+    grids = grid_cm[:C].T[:B]                      # (B, C)
     return SolveResult(
-        grid=grids[:B].reshape(B, N, N),
-        solved=status[:B, 0] == SOLVED,
-        status=status[:B, 0],
-        guesses=guesses[:B, 0],
-        validations=vals[:B, 0],
-        iters=iters.max(),
+        grid=grids.reshape(B, N, N),
+        solved=meta[0, :B] == SOLVED,
+        status=meta[0, :B],
+        guesses=meta[1, :B],
+        validations=meta[2, :B],
+        iters=meta[3].max(),
     )

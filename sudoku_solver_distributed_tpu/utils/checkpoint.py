@@ -190,12 +190,21 @@ def solve_batch_resumable(
             _run_chunk(state, spec, chunk_iters, max_iters)
         )
         done = not bool(np.asarray(state.status == S.RUNNING).any())
-        if done or int(state.iters) >= max_iters:
+        if done:
             break
         save_solver_state(checkpoint_path, state, spec, fingerprint)
+        if int(state.iters) >= max_iters:
+            # budget exhausted with boards still RUNNING: the snapshot just
+            # written is the resume point — a re-run with a larger
+            # max_iters continues from here instead of iteration 0
+            break
 
     state = S.finalize_status(state, spec)
-    if not keep_checkpoint and os.path.exists(checkpoint_path):
+    if (
+        done
+        and not keep_checkpoint
+        and os.path.exists(checkpoint_path)
+    ):
         os.unlink(checkpoint_path)
 
     B, N = grid.shape[0], spec.size

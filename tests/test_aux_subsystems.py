@@ -235,3 +235,23 @@ def test_resumable_sharded_over_mesh(tmp_path):
     assert bool(np.asarray(res.solved).all())
     direct = solve_batch(np.asarray(boards), SPEC_9)
     np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(direct.grid))
+
+
+def test_resumable_keeps_snapshot_on_budget_exhaustion(tmp_path):
+    """max_iters exhausted with boards still RUNNING must leave the snapshot
+    on disk (it is the resume point), and a re-run with a larger budget must
+    finish from it rather than restarting at iteration 0."""
+    boards = generate_batch(4, 58, seed=201, unique=True)
+    ck = str(tmp_path / "budget.npz")
+    res = solve_batch_resumable(
+        boards, SPEC_9, checkpoint_path=ck, chunk_iters=4, max_iters=8
+    )
+    assert bool(np.asarray(res.status == S.RUNNING).any())
+    assert os.path.exists(ck), "snapshot discarded on budget exhaustion"
+
+    res2 = solve_batch_resumable(
+        boards, SPEC_9, checkpoint_path=ck, chunk_iters=64
+    )
+    assert bool(np.asarray(res2.solved).all())
+    assert int(res2.iters) >= 8  # continued, not restarted
+    assert not os.path.exists(ck)

@@ -51,3 +51,22 @@ def test_pallas_empty_board_depth_default():
     res = _pallas(np.zeros((1, 9, 9), np.int32), block=1)
     assert int(res.status[0]) == SOLVED
     assert int(res.guesses[0]) >= 40  # genuinely deep, not a shallow fluke
+
+
+def test_engine_pallas_backend():
+    """The kernel is reachable from serving as an engine backend (interpret
+    mode off-TPU, Mosaic on a real chip)."""
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+
+    eng = SolverEngine(buckets=(8,), backend="pallas")
+    boards = generate_batch(8, 50, seed=34, unique=True)
+    solutions, solved_mask, info = eng.solve_batch_np(np.asarray(boards))
+    assert bool(solved_mask.all())
+    assert oracle_is_valid_solution(solutions[0].tolist())
+    ref = solve_batch(jnp.asarray(boards), SPEC_9)
+    np.testing.assert_array_equal(solutions, np.asarray(ref.grid))
+    assert info["validations"] > 0 and eng.solved_puzzles == 8
+
+    with pytest.raises(ValueError, match="unknown engine backend"):
+        SolverEngine(backend="cuda")
