@@ -421,12 +421,55 @@ def _compaction_schedule(B: int) -> list:
     return caps
 
 
+def _retry_overflow(
+    grid: jnp.ndarray,
+    res: SolveResult,
+    spec: BoardSpec,
+    depth: int,
+    max_iters: int,
+    compact: bool,
+    widen_after: int | None,
+) -> SolveResult:
+    """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
+
+    The whole retry sits behind a ``lax.cond`` on "any overflow", so a batch
+    that fits the shallow stack pays one reduction and nothing else — that's
+    what makes a small first-stage depth safe as the default fast path.
+    Non-overflow lanes are replaced by an instantly-UNSAT pad board (the
+    compaction loop drops them after one iteration) and keep their original
+    result; overflow lanes get the retry's result, with work counters
+    accumulated across stages.
+    """
+    need = res.status == OVERFLOW
+
+    def do(_):
+        N = spec.size
+        pad = jnp.zeros((N, N), jnp.int32).at[0, 0].set(1).at[0, 1].set(1)
+        g2 = jnp.where(need[:, None, None], grid.astype(jnp.int32), pad)
+        r2 = solve_batch(
+            g2, spec, max_iters=max_iters, max_depth=depth,
+            compact=compact, widen_after=widen_after,
+        )
+        return SolveResult(
+            grid=jnp.where(need[:, None, None], r2.grid, res.grid),
+            solved=jnp.where(need, r2.solved, res.solved),
+            status=jnp.where(need, r2.status, res.status),
+            guesses=jnp.where(need, res.guesses + r2.guesses, res.guesses),
+            validations=jnp.where(
+                need, res.validations + r2.validations, res.validations
+            ),
+            iters=res.iters + r2.iters,
+        )
+
+    return jax.lax.cond(need.any(), do, lambda _: res, None)
+
+
 def solve_batch(
     grid: jnp.ndarray,
     spec: BoardSpec,
     *,
     max_iters: int = 4096,
-    max_depth: int | None = None,
+    max_depth: int | tuple | None = None,
     compact: bool = True,
     widen_after: int | None = None,
 ) -> SolveResult:
@@ -437,6 +480,16 @@ def solve_batch(
       max_iters: lockstep iteration cap (safety net; typical 9×9 batches
         finish in well under 100 iterations).
       max_depth: guess-stack capacity override (default spec.max_depth).
+        A tuple stages the depth: the batch first runs with depth[0], and
+        boards that hit OVERFLOW rerun with each deeper stage under a
+        ``lax.cond`` that costs nothing when no board overflowed. The stack
+        is the dominant state (snapshots are (B, D, C)): the compaction
+        sorts and per-iteration push/pop traffic scale with D, so e.g.
+        ``(32, 81)`` on hard 9×9 corpora runs ~25% faster than a flat 64
+        while keeping the full-depth guarantee (measured 2026-07, v5e).
+        Staging is for the plain jit path: under ``vmap`` the ``lax.cond``
+        lowers to a select that runs BOTH branches, making every stage's
+        retry execute unconditionally — use a flat depth there.
       compact: shrink the lockstep batch as boards finish (see
         ``_run_compacted``); semantically identical, far faster on large
         batches whose hardest boards need many more iterations than the
@@ -454,6 +507,18 @@ def solve_batch(
 
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """
+    if isinstance(max_depth, (tuple, list)):
+        depths = tuple(max_depth)
+        res = solve_batch(
+            grid, spec, max_iters=max_iters, max_depth=depths[0],
+            compact=compact, widen_after=widen_after,
+        )
+        for d in depths[1:]:
+            res = _retry_overflow(
+                grid, res, spec, d, max_iters, compact, widen_after
+            )
+        return res
+
     B = grid.shape[0]
     state = init_state(grid, spec, max_depth)
 

@@ -149,3 +149,33 @@ def test_validations_counted():
     res = _solve(boards)
     assert (np.asarray(res.validations) >= 1).all()
     assert int(res.iters) >= 1
+
+
+def test_staged_depth_overflow_retry():
+    """max_depth as a tuple: shallow stage, then OVERFLOW boards rerun with
+    the deeper stack — results identical to a flat deep run."""
+    import jax.numpy as jnp
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    # an empty board needs ~47 guess frames: depth 8 must overflow, the
+    # staged retry at 64 must solve it the same way a flat 64 run does
+    batch = np.zeros((4, 9, 9), np.int32)
+    batch[1:] = generate_batch(3, 55, seed=51, unique=True)
+    staged = solve_batch(jnp.asarray(batch), SPEC_9, max_depth=(8, 64))
+    flat = solve_batch(jnp.asarray(batch), SPEC_9, max_depth=64)
+    assert bool(np.asarray(staged.solved).all())
+    np.testing.assert_array_equal(
+        np.asarray(staged.grid), np.asarray(flat.grid)
+    )
+    # stage-1 work is accounted on top of the retry's
+    assert int(staged.validations[0]) > int(flat.validations[0])
+
+    # no overflow in stage 1 -> bit-identical to the flat shallow run
+    easy = generate_batch(8, 40, seed=52)
+    s2 = solve_batch(jnp.asarray(easy), SPEC_9, max_depth=(32, 64))
+    f2 = solve_batch(jnp.asarray(easy), SPEC_9, max_depth=32)
+    np.testing.assert_array_equal(np.asarray(s2.grid), np.asarray(f2.grid))
+    np.testing.assert_array_equal(
+        np.asarray(s2.validations), np.asarray(f2.validations)
+    )
