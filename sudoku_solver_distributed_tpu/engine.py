@@ -83,6 +83,12 @@ class SolverEngine:
         self.frontier_mesh = frontier_mesh
         self.frontier_states_per_device = frontier_states_per_device
         self.backend = backend
+        # Multi-host frontier serving: when set (a callable board ->
+        # (solution | None, info)), single-board solves delegate to it
+        # instead of calling frontier_solve locally — the CLI points this
+        # at FrontierServingLoop.solve on the leader host so every host
+        # enters the collective race in lockstep (parallel/serving_loop.py).
+        self.frontier_runner = None
         # when set, batch device calls are captured as jax.profiler traces
         # under this directory (utils/profiling.py; CLI --profile-dir); only
         # one trace can be active per process, so concurrent requests skip
@@ -132,6 +138,12 @@ class SolverEngine:
         # buffer (different trailing shape), so donation would be a no-op
         # that only emits "donated buffers were not usable" warnings
         self._solve = jax.jit(_run)
+
+    @property
+    def frontier_enabled(self) -> bool:
+        """True when single-board solves route through the frontier race
+        (local mesh or multi-host serving loop)."""
+        return self.frontier_mesh is not None or self.frontier_runner is not None
 
     # -- internals ---------------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -237,15 +249,18 @@ class SolverEngine:
     def _frontier_raw(self, arr: np.ndarray):
         """Run the race without serving-stats side effects; _frontier_solve
         wraps it with the counter accounting."""
-        from .parallel import frontier_solve
+        if self.frontier_runner is not None:
+            solution, info = self.frontier_runner(arr)
+        else:
+            from .parallel import frontier_solve
 
-        solution, info = frontier_solve(
-            arr,
-            self.frontier_mesh,
-            self.spec,
-            states_per_device=self.frontier_states_per_device,
-            max_depth=self.max_depth,
-        )
+            solution, info = frontier_solve(
+                arr,
+                self.frontier_mesh,
+                self.spec,
+                states_per_device=self.frontier_states_per_device,
+                max_depth=self.max_depth,
+            )
         return solution, dict(info, frontier=True)
 
     def _frontier_solve(self, arr: np.ndarray):
@@ -318,9 +333,9 @@ class SolverEngine:
         tasks use it so farmed cells never occupy the whole mesh."""
         arr = np.asarray(board, np.int32)
         use_frontier = (
-            self.frontier_mesh is not None
+            self.frontier_enabled
             if frontier is None
-            else (frontier and self.frontier_mesh is not None)
+            else (frontier and self.frontier_enabled)
         )
         if use_frontier:
             return self._frontier_solve(arr)

@@ -127,19 +127,6 @@ def main(argv=None) -> None:
 
         jax.config.update("jax_platforms", args.platform)
 
-    if args.frontier > 0 and args.coordinator and args.num_hosts > 1:
-        # The frontier racer is a collective program over the mesh; in
-        # multi-host mode every host would have to enter it in lockstep,
-        # but /solve is driven by one host's HTTP thread — the others
-        # would never join and the request would hang. Needs an SPMD
-        # serving loop (ROADMAP); refuse loudly instead (and before the
-        # distributed init below, which blocks on the coordinator).
-        raise SystemExit(
-            "--frontier is single-host only (the frontier race is a "
-            "whole-mesh collective; multi-host serving needs an SPMD "
-            "request loop). Drop --frontier or --coordinator."
-        )
-
     if args.coordinator:
         # Pod-slice mode: every host runs this same CLI; XLA collectives ride
         # ICI/DCN underneath while the UDP/HTTP control plane stays host-side.
@@ -157,12 +144,29 @@ def main(argv=None) -> None:
     kwargs = {"spec": spec_for_size(args.board_size), "backend": args.backend}
     if args.buckets:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
-    if args.frontier > 0:
+    multi_host = bool(args.coordinator) and args.num_hosts > 1
+    serving_loop = None
+    if args.frontier > 0 and not multi_host:
         from ..parallel import default_mesh
 
         kwargs["frontier_mesh"] = default_mesh()
         kwargs["frontier_states_per_device"] = args.frontier
     engine = SolverEngine(**kwargs)
+    if args.frontier > 0 and multi_host:
+        # The racer is a collective over the global mesh: every host enters
+        # it in lockstep through the SPMD serving loop, and the leader's
+        # HTTP thread feeds requests into it (parallel/serving_loop.py).
+        # Non-leader hosts serve /solve from their local bucket path.
+        from ..parallel import FrontierServingLoop, default_mesh
+
+        serving_loop = FrontierServingLoop(
+            default_mesh(),
+            engine.spec,
+            states_per_device=args.frontier,
+        )
+        serving_loop.start()
+        if serving_loop.is_leader:
+            engine.frontier_runner = serving_loop.solve
     from ..utils.profiling import RequestMetrics
 
     node = P2PNode(
@@ -191,3 +195,5 @@ def main(argv=None) -> None:
         node.run()
     finally:
         httpd.shutdown()
+        if serving_loop is not None and serving_loop.is_leader:
+            serving_loop.stop()

@@ -317,10 +317,15 @@ class P2PNode:
     # -- master side -------------------------------------------------------
     def peer_sudoku_solve(self, sudoku) -> Optional[list]:
         """Solve a request board, farming cells to peers when there are any
-        (reference node.py:534-557). Returns the solved grid or None."""
+        (reference node.py:534-557). Returns the solved grid or None.
+
+        With the frontier engine enabled the mesh race *is* the distributed
+        path — it replaces the per-cell peer farm for the request (P2P peers
+        still carry membership/stats), the same way the reference's
+        distributed dispatch is its serving path."""
         with self._solve_lock:
             peers = [p for p in self.membership.total_peers()]
-            if not peers:
+            if not peers or self.engine.frontier_enabled:
                 solution, _ = self.engine.solve_one(sudoku)
             else:
                 solution = self._farm_solve(sudoku, peers)

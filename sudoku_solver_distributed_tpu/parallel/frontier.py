@@ -310,7 +310,22 @@ def frontier_solve(
         )
         states = np.concatenate([states, pad], axis=0)
     racer = _make_racer(mesh, spec, max_iters, max_depth)
-    packed = np.asarray(racer(jnp.asarray(states)))
+    if len(mesh.devices.flatten()) > len(jax.local_devices()):
+        # multi-host mesh (serving_loop.py): every host ran the same
+        # deterministic seeding and holds the full identical states array;
+        # build the global batch-sharded array by having each host supply
+        # its addressable shards from its local copy. The racer's output is
+        # replicated, so every host reads the same packed row.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P("data"))
+        host_states = np.asarray(states)
+        global_states = jax.make_array_from_callback(
+            host_states.shape, sharding, lambda idx: host_states[idx]
+        )
+        packed = np.asarray(racer(global_states))
+    else:
+        packed = np.asarray(racer(jnp.asarray(states)))
     C = spec.cells
     found, validations = bool(packed[C]), int(packed[C + 1])
     info = {"validations": validations, "seeded": len(states)}

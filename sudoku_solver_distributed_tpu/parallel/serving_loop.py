@@ -1,0 +1,169 @@
+"""SPMD multi-host serving loop for the frontier race.
+
+The frontier racer (frontier.py) is a collective program over the mesh; on a
+multi-host pod slice every host must enter it in lockstep, but a `/solve`
+arrives at ONE host's HTTP thread. This module closes that gap the standard
+SPMD-serving way: every host runs the same loop —
+
+    tick:    payload = broadcast_one_to_all(request | idle)   # host 0 feeds
+    if request: frontier_solve(board)                          # collective
+    host 0:  hand the result back to the waiting HTTP thread
+
+so the other hosts follow host 0 into every collective at the same point in
+the program, and the reference-compatible HTTP surface stays exactly where
+it was (one node answers the client; the mesh does the work). This is the
+TPU-native analog of the reference's master/worker UDP hop (reference
+node.py:427-475): the "dispatch" is a broadcast over DCN, the "work" rides
+ICI inside the racer, and the "collect" is the racer's own all_gather.
+
+Single-host meshes don't need any of this — the engine calls
+``frontier_solve`` directly (engine.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..ops import BoardSpec, SPEC_9
+
+logger = logging.getLogger(__name__)
+
+_IDLE, _REQUEST, _STOP = 0, 1, 2
+_POLL_S = 0.05  # idle tick cadence; latency floor for a quiet cluster
+
+
+class FrontierServingLoop:
+    """Lockstep frontier serving across all hosts of a mesh.
+
+    Construct (with identical arguments) and ``start()`` on EVERY host of
+    the ``jax.distributed`` cluster. Host 0 additionally calls ``solve``
+    per request and ``stop()`` at shutdown; the other hosts follow through
+    the broadcasts.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        spec: BoardSpec = SPEC_9,
+        *,
+        states_per_device: int = 64,
+        max_depth: Optional[int] = None,
+    ):
+        import jax
+
+        self.mesh = mesh
+        self.spec = spec
+        self.states_per_device = states_per_device
+        self.max_depth = max_depth
+        self.is_leader = jax.process_index() == 0
+        self._requests: queue.Queue = queue.Queue()
+        self._results: queue.Queue = queue.Queue()
+        self._solve_mutex = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- internals ---------------------------------------------------------
+    def _payload(self, flag: int, board=None) -> np.ndarray:
+        C = self.spec.cells
+        buf = np.zeros((C + 1,), np.int32)
+        buf[0] = flag
+        if board is not None:
+            buf[1:] = np.asarray(board, np.int32).reshape(C)
+        return buf
+
+    def _solve_collective(self, board: np.ndarray):
+        from .frontier import frontier_solve
+
+        return frontier_solve(
+            board.reshape(self.spec.size, self.spec.size),
+            self.mesh,
+            self.spec,
+            states_per_device=self.states_per_device,
+            max_depth=self.max_depth,
+        )
+
+    def _run(self) -> None:
+        from jax.experimental import multihost_utils
+
+        try:
+            while True:
+                if self.is_leader:
+                    try:
+                        payload = self._requests.get(timeout=_POLL_S)
+                    except queue.Empty:
+                        payload = self._payload(_IDLE)
+                else:
+                    payload = self._payload(_IDLE)  # ignored off-leader
+                buf = np.asarray(
+                    multihost_utils.broadcast_one_to_all(payload), np.int32
+                )
+                flag = int(buf[0])
+                if flag == _STOP:
+                    break
+                if flag == _IDLE:
+                    continue
+                logger.info(
+                    "frontier serving loop: racing a board (%d clues)",
+                    int((buf[1:] > 0).sum()),
+                )
+                try:
+                    result = ("ok", self._solve_collective(buf[1:]))
+                except Exception as e:  # noqa: BLE001 — surfaced to caller
+                    # A failed collective may leave hosts out of sync; stop
+                    # the loop rather than risk a deadlocked next broadcast.
+                    logger.exception("frontier serving loop: solve failed")
+                    if self.is_leader:
+                        self._results.put(("error", e))
+                    break
+                if self.is_leader:
+                    self._results.put(result)
+        finally:
+            self._stopped.set()
+
+    # -- public API --------------------------------------------------------
+    def start(self) -> None:
+        """Start the loop thread (every host). Leader warms the collective
+        path by racing one empty board through the loop so the first real
+        request hits compiled programs on every host."""
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if self.is_leader:
+            self.solve(np.zeros((self.spec.size, self.spec.size), np.int32))
+
+    def solve(self, board, timeout: float = 600.0):
+        """Leader-only: run one board through the collective race.
+        Returns (solution | None, info) like ``frontier_solve``.
+
+        Serialized by a mutex: the request/result queues are unkeyed, so
+        concurrent callers must not interleave (each call owns the loop for
+        its duration). Raises if the loop died or the collective failed —
+        never hangs the HTTP thread."""
+        assert self.is_leader, "solve() is for process 0; others follow"
+        with self._solve_mutex:
+            if self._stopped.is_set():
+                raise RuntimeError("frontier serving loop is stopped")
+            self._requests.put(self._payload(_REQUEST, board))
+            try:
+                kind, value = self._results.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"frontier serving loop: no result in {timeout}s"
+                ) from None
+            if kind == "error":
+                raise value
+            return value
+
+    def stop(self) -> None:
+        """Leader-only: stop the loop on every host (via the broadcast)."""
+        if self.is_leader and not self._stopped.is_set():
+            self._requests.put(self._payload(_STOP))
+        self._stopped.wait(timeout=30)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Non-leader hosts: block until the leader broadcasts STOP."""
+        self._stopped.wait(timeout=timeout)

@@ -216,3 +216,110 @@ def test_two_process_cli_coordinator_http():
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
+
+
+@pytest.mark.slow
+def test_two_process_cli_frontier_serving_loop():
+    """--frontier in multi-host mode: every host enters the collective
+    frontier race in lockstep through the SPMD serving loop
+    (parallel/serving_loop.py), and the leader's HTTP /solve serves the
+    README 8-clue board from it."""
+    import json
+    import time
+    import urllib.request
+
+    coord = f"127.0.0.1:{_free_tcp_port()}"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_COMPILATION_CACHE_DIR=os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_sudoku_tpu"
+        ),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    http0, http1 = _free_tcp_port(), _free_tcp_port()
+    udp0, udp1 = _free_tcp_port(), _free_tcp_port()
+    common = ["-h", "0", "--buckets", "1",
+              "--frontier", "4",
+              "--coordinator", coord, "--num-hosts", "2"]
+    import tempfile
+
+    host1_log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".log", delete=False
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "node.py"),
+             "-p", str(http0), "-s", str(udp0), "--host-id", "0"] + common,
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ),
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "node.py"),
+             "-p", str(http1), "-s", str(udp1), "--host-id", "1",
+             "-a", f"127.0.0.1:{udp0}"] + common,
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=host1_log,
+        ),
+    ]
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            for k, p in enumerate(procs):
+                if p.poll() is not None:
+                    raise AssertionError(f"node {k} exited rc={p.returncode}")
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http0}/stats", timeout=2
+                )
+                break
+            except Exception:
+                time.sleep(0.5)
+
+        readme = [
+            [0, 0, 0, 1, 0, 0, 0, 0, 0],
+            [0, 0, 0, 3, 2, 0, 0, 0, 0],
+            [0, 0, 0, 0, 0, 9, 0, 0, 0],
+            [0, 0, 0, 0, 0, 0, 0, 7, 0],
+            [0, 0, 0, 0, 0, 0, 0, 0, 0],
+            [0, 0, 0, 9, 0, 0, 0, 0, 0],
+            [0, 0, 0, 0, 0, 0, 9, 0, 0],
+            [0, 0, 0, 0, 0, 0, 0, 0, 3],
+            [0, 0, 0, 0, 0, 0, 0, 0, 0],
+        ]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http0}/solve",
+            data=json.dumps({"sudoku": readme}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=240) as r:
+            solution = json.loads(r.read())
+        assert all(all(v != 0 for v in row) for row in solution)
+        for i in range(9):
+            for j in range(9):
+                if readme[i][j]:
+                    assert solution[i][j] == readme[i][j]
+        assert all(p.poll() is None for p in procs), "a host crashed"
+        # host 1 entered the collective race for the REQUEST too, not just
+        # the start() warmup — proves the loop serves /solve (an 8-clue
+        # line beyond the warmup's 0-clue one)
+        host1_log.flush()
+        with open(host1_log.name) as f:
+            races = [
+                line for line in f
+                if "frontier serving loop: racing a board" in line
+            ]
+        assert any("(8 clues)" in line for line in races), races
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        os.unlink(host1_log.name)
