@@ -70,3 +70,19 @@ def test_engine_pallas_backend():
 
     with pytest.raises(ValueError, match="unknown engine backend"):
         SolverEngine(backend="cuda")
+
+
+def test_pallas_16x16_matches_xla():
+    """The transposed layout and MXU incidence-matrix analysis generalize
+    beyond 9×9: hexadoku through the same kernel (interpret mode)."""
+    from sudoku_solver_distributed_tpu.ops import spec_for_size
+
+    spec16 = spec_for_size(16)
+    boards = generate_batch(2, 80, size=16, seed=35)
+    ref = solve_batch(jnp.asarray(boards), spec16, max_iters=8192)
+    res = solve_batch_pallas(
+        jnp.asarray(boards, jnp.int32), spec16, block=2,
+        max_depth=64, max_iters=8192, interpret=True,
+    )
+    assert bool(np.asarray(res.solved).all()), np.asarray(res.status)
+    np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(ref.grid))
