@@ -93,7 +93,8 @@ def main():
     max_depth = {9: (32, 81), 16: (64, 256), 25: None}[BENCH_SIZE]
     solve = jax.jit(
         lambda g: solve_batch(
-            g, spec, max_depth=max_depth, max_iters=_MAX_ITERS[BENCH_SIZE]
+            g, spec, max_depth=max_depth, max_iters=_MAX_ITERS[BENCH_SIZE],
+            locked_candidates=True
         )
     )
 

@@ -52,6 +52,10 @@ class SolverEngine:
         compacted lockstep loop; default) or "pallas" (ops/pallas_solver.py,
         the VMEM-resident per-block kernel; interpret mode is selected
         automatically off-TPU so tests run anywhere).
+      locked_candidates: locked-candidate (pointing + claiming)
+        eliminations in the solver's analysis sweeps — sound, ~30% faster
+        on hard corpora (ops/solver.py). Default: on for the xla backend;
+        unsupported by the pallas kernel (passing True with it raises).
     """
 
     def __init__(
@@ -64,6 +68,7 @@ class SolverEngine:
         frontier_mesh: Optional[jax.sharding.Mesh] = None,
         frontier_states_per_device: int = 64,
         backend: str = "xla",
+        locked_candidates: Optional[bool] = None,
     ):
         if backend not in ("xla", "pallas"):
             raise ValueError(f"unknown engine backend {backend!r}")
@@ -83,6 +88,13 @@ class SolverEngine:
         self.frontier_mesh = frontier_mesh
         self.frontier_states_per_device = frontier_states_per_device
         self.backend = backend
+        if locked_candidates is None:
+            locked_candidates = backend == "xla"
+        if locked_candidates and backend == "pallas":
+            raise ValueError(
+                "locked_candidates is not supported by the pallas kernel"
+            )
+        self.locked_candidates = locked_candidates
         # Multi-host frontier serving: when set (a callable board ->
         # (solution | None, info)), single-board solves delegate to it
         # instead of calling frontier_solve locally — the CLI points this
@@ -118,7 +130,12 @@ class SolverEngine:
                     interpret=jax.default_backend() != "tpu",
                 )
             else:
-                res = solve_batch(grid, self.spec, max_depth=self.max_depth)
+                res = solve_batch(
+                    grid,
+                    self.spec,
+                    max_depth=self.max_depth,
+                    locked_candidates=self.locked_candidates,
+                )
             # Pack every result field into ONE int32 array: the serving path
             # pays exactly one device→host transfer per request. (Unpacked,
             # each field is its own transfer — at ~70 ms RTT over a tunneled
@@ -211,6 +228,7 @@ class SolverEngine:
                 self.spec,
                 frontier.DEFAULT_MAX_ITERS,
                 self.max_depth,
+                self.locked_candidates,
             )
             for mult in (1, 2, 4):
                 pad = np.broadcast_to(
@@ -260,6 +278,7 @@ class SolverEngine:
                 self.spec,
                 states_per_device=self.frontier_states_per_device,
                 max_depth=self.max_depth,
+                locked=self.locked_candidates,
             )
         return solution, dict(info, frontier=True)
 
@@ -306,6 +325,7 @@ class SolverEngine:
             max_depth=self.max_depth,
             keep_checkpoint=keep_checkpoint,
             sharding=self.sharding,
+            locked=self.locked_candidates,
         )
         solved_mask = np.asarray(res.solved)
         validations = int(np.asarray(res.validations).sum())

@@ -163,6 +163,7 @@ def main(argv=None) -> None:
             default_mesh(),
             engine.spec,
             states_per_device=args.frontier,
+            locked=engine.locked_candidates,
         )
         serving_loop.start()
         if serving_loop.is_leader:

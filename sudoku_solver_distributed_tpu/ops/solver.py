@@ -69,7 +69,7 @@ def _mrv_cell(grid: jnp.ndarray, cand: jnp.ndarray):
     return cell, cand[b, cell]
 
 
-def _step(state: _State, spec: BoardSpec) -> _State:
+def _step(state: _State, spec: BoardSpec, locked: bool = False) -> _State:
     B, C = state.grid.shape
     D = state.stack_mask.shape[1]
     N = spec.size
@@ -77,7 +77,7 @@ def _step(state: _State, spec: BoardSpec) -> _State:
 
     # One fused sweep analysis shared with the standalone propagator
     # (ops/propagate.py): candidates, forced singles, contradiction, solved.
-    a = analyze(state.grid.reshape(B, N, N), spec)
+    a = analyze(state.grid.reshape(B, N, N), spec, locked=locked)
     cand = a.cand.reshape(B, C)
     assign = a.assign.reshape(B, C)
     contra, solved = a.contradiction, a.solved
@@ -182,9 +182,9 @@ def init_state(
     )
 
 
-def step(state: _State, spec: BoardSpec) -> _State:
+def step(state: _State, spec: BoardSpec, locked: bool = False) -> _State:
     """One lockstep solver iteration over the batch (public; see init_state)."""
-    return _step(state, spec)
+    return _step(state, spec, locked)
 
 
 def finalize_status(state: _State, spec: BoardSpec) -> _State:
@@ -235,7 +235,9 @@ def _write_boards(state: _State, sub: _State, count: int) -> _State:
     )
 
 
-def _run_widened(state: _State, spec: BoardSpec, max_iters: int) -> _State:
+def _run_widened(
+    state: _State, spec: BoardSpec, max_iters: int, locked: bool = False
+) -> _State:
     """Race the pathological tail: restart each still-RUNNING board from its
     search root and explore all top-level candidates of its MRV cell as
     parallel children.
@@ -270,7 +272,7 @@ def _run_widened(state: _State, spec: BoardSpec, max_iters: int) -> _State:
         state.grid,
     )
 
-    a = analyze(root.reshape(R, N, N), spec)
+    a = analyze(root.reshape(R, N, N), spec, locked=locked)
     cand = a.cand.reshape(R, C)
     cell, cmask = _mrv_cell(root, cand)                       # (R,), (R,)
 
@@ -301,7 +303,7 @@ def _run_widened(state: _State, spec: BoardSpec, max_iters: int) -> _State:
     def cond(ws):
         return (~parents_done(ws)).any() & (ws.iters < max_iters)
 
-    w = jax.lax.while_loop(cond, lambda ws: _step(ws, spec), w)
+    w = jax.lax.while_loop(cond, lambda ws: _step(ws, spec, locked), w)
     w = finalize_status(w, spec)
 
     st = w.status.reshape(R, N)
@@ -352,6 +354,7 @@ def _run_compacted(
     spec: BoardSpec,
     max_iters: int,
     widen_after: int | None = None,
+    locked: bool = False,
 ) -> _State:
     """Run the lockstep loop with hierarchical active-board compaction.
 
@@ -376,7 +379,9 @@ def _run_compacted(
             return running_of(s).any() & (s.iters < max_iters)
 
         if widen_after is None:
-            return jax.lax.while_loop(cond, lambda s: _step(s, spec), state)
+            return jax.lax.while_loop(
+                cond, lambda s: _step(s, spec, locked), state
+            )
 
         grace_end = jnp.minimum(state.iters + widen_after, max_iters)
 
@@ -384,11 +389,11 @@ def _run_compacted(
             return running_of(s).any() & (s.iters < grace_end)
 
         state = jax.lax.while_loop(
-            grace_cond, lambda s: _step(s, spec), state
+            grace_cond, lambda s: _step(s, spec, locked), state
         )
         return jax.lax.cond(
             running_of(state).any(),
-            lambda s: _run_widened(s, spec, max_iters),
+            lambda s: _run_widened(s, spec, max_iters, locked),
             lambda s: s,
             state,
         )
@@ -399,7 +404,7 @@ def _run_compacted(
         # running.sum() > next_cap (≥ 64) subsumes running.any()
         return (s.iters < max_iters) & (running_of(s).sum() > next_cap)
 
-    state = jax.lax.while_loop(cond, lambda s: _step(s, spec), state)
+    state = jax.lax.while_loop(cond, lambda s: _step(s, spec, locked), state)
 
     # Stable sort: RUNNING boards (key 0) to the front, finished (key 1) after.
     perm = jnp.argsort((~running_of(state)).astype(jnp.int32), stable=True)
@@ -408,7 +413,7 @@ def _run_compacted(
     sub = jax.tree.map(
         lambda x: x[:next_cap] if x.ndim else x, permuted
     )
-    sub = _run_compacted(sub, caps[1:], spec, max_iters, widen_after)
+    sub = _run_compacted(sub, caps[1:], spec, max_iters, widen_after, locked)
     merged = _write_boards(permuted, sub, next_cap)
     return _take_boards(merged, inv)
 
@@ -429,6 +434,7 @@ def _retry_overflow(
     max_iters: int,
     compact: bool,
     widen_after: int | None,
+    locked: bool = False,
 ) -> SolveResult:
     """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
 
@@ -449,6 +455,7 @@ def _retry_overflow(
         r2 = solve_batch(
             g2, spec, max_iters=max_iters, max_depth=depth,
             compact=compact, widen_after=widen_after,
+            locked_candidates=locked,
         )
         return SolveResult(
             grid=jnp.where(need[:, None, None], r2.grid, res.grid),
@@ -472,6 +479,7 @@ def solve_batch(
     max_depth: int | tuple | None = None,
     compact: bool = True,
     widen_after: int | None = None,
+    locked_candidates: bool = False,
 ) -> SolveResult:
     """Solve a batch of boards to completion (or proven unsatisfiability).
 
@@ -504,6 +512,14 @@ def solve_batch(
         ordering. The widened batch is (last level size)×N children, so with
         ``compact=False`` the *whole batch* would widen ×N; to keep memory
         bounded the option is ignored when that product exceeds 8192 boards.
+      locked_candidates: apply locked-candidate (pointing + claiming)
+        eliminations in every analysis sweep (ops/propagate.py). Sound and
+        strictly narrowing — fewer guesses and iterations at slightly more
+        work per sweep; measured 2026-07-30 on the hard-9×9 corpus: 653→540
+        iterations, 28.8k→19.2k guesses, ~+30% throughput. Off by default
+        so the default search order matches the other backends (a different
+        — equally valid — solution can be returned for multi-solution
+        boards).
 
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """
@@ -512,10 +528,12 @@ def solve_batch(
         res = solve_batch(
             grid, spec, max_iters=max_iters, max_depth=depths[0],
             compact=compact, widen_after=widen_after,
+            locked_candidates=locked_candidates,
         )
         for d in depths[1:]:
             res = _retry_overflow(
-                grid, res, spec, d, max_iters, compact, widen_after
+                grid, res, spec, d, max_iters, compact, widen_after,
+                locked_candidates,
             )
         return res
 
@@ -525,7 +543,9 @@ def solve_batch(
     caps = _compaction_schedule(B) if compact else [B]
     if widen_after is not None and caps[-1] * spec.size > 8192:
         widen_after = None  # see docstring: bound the widened batch's memory
-    state = _run_compacted(state, caps, spec, max_iters, widen_after)
+    state = _run_compacted(
+        state, caps, spec, max_iters, widen_after, locked_candidates
+    )
     state = finalize_status(state, spec)
 
     N = spec.size

@@ -206,7 +206,13 @@ def warm_seeding(spec: BoardSpec, target: int) -> None:
 
 
 @lru_cache(maxsize=None)
-def _make_racer(mesh, spec: BoardSpec, max_iters: int, max_depth: Optional[int]):
+def _make_racer(
+    mesh,
+    spec: BoardSpec,
+    max_iters: int,
+    max_depth: Optional[int],
+    locked: bool = False,
+):
     """Compile the shard_map race: lockstep DFS with per-iteration early exit.
 
     Cached on (mesh, spec, max_iters, max_depth) — a fresh closure per call
@@ -232,7 +238,7 @@ def _make_racer(mesh, spec: BoardSpec, max_iters: int, max_depth: Optional[int])
 
         def body(carry):
             st, _ = carry
-            st = S.step(st, spec)
+            st = S.step(st, spec, locked)
             local_hit = (st.status == S.SOLVED).any()
             found = jax.lax.psum(local_hit.astype(jnp.int32), "data") > 0
             return st, found
@@ -276,6 +282,7 @@ def frontier_solve(
     states_per_device: int = 64,
     max_iters: int = DEFAULT_MAX_ITERS,
     max_depth: Optional[int] = None,
+    locked: bool = False,
 ) -> Tuple[Optional[list], dict]:
     """Solve one (hard) board by racing its search subtrees across the mesh.
 
@@ -309,7 +316,7 @@ def frontier_solve(
             _unsat_pad(spec), (total - len(states), spec.size, spec.size)
         )
         states = np.concatenate([states, pad], axis=0)
-    racer = _make_racer(mesh, spec, max_iters, max_depth)
+    racer = _make_racer(mesh, spec, max_iters, max_depth, locked)
     if len(mesh.devices.flatten()) > len(jax.local_devices()):
         # multi-host mesh (serving_loop.py): every host ran the same
         # deterministic seeding and holds the full identical states array;

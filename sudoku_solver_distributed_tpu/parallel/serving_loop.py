@@ -53,6 +53,7 @@ class FrontierServingLoop:
         *,
         states_per_device: int = 64,
         max_depth: Optional[int] = None,
+        locked: bool = False,
     ):
         import jax
 
@@ -60,6 +61,7 @@ class FrontierServingLoop:
         self.spec = spec
         self.states_per_device = states_per_device
         self.max_depth = max_depth
+        self.locked = locked  # must be identical on every host
         self.is_leader = jax.process_index() == 0
         self._requests: queue.Queue = queue.Queue()
         self._results: queue.Queue = queue.Queue()
@@ -85,6 +87,7 @@ class FrontierServingLoop:
             self.spec,
             states_per_device=self.states_per_device,
             max_depth=self.max_depth,
+            locked=self.locked,
         )
 
     def _run(self) -> None:

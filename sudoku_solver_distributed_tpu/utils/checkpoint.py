@@ -112,15 +112,21 @@ def load_solver_state(
     )
 
 
-@partial(jax.jit, static_argnames=("spec", "chunk", "max_iters"))
-def _run_chunk(state: S._State, spec: BoardSpec, chunk: int, max_iters: int):
+@partial(jax.jit, static_argnames=("spec", "chunk", "max_iters", "locked"))
+def _run_chunk(
+    state: S._State,
+    spec: BoardSpec,
+    chunk: int,
+    max_iters: int,
+    locked: bool = False,
+):
     """Advance every RUNNING board by ≤``chunk`` lockstep iterations."""
     target = jax.numpy.minimum(state.iters + chunk, max_iters)
 
     def cond(s):
         return ((s.status == S.RUNNING).any()) & (s.iters < target)
 
-    return jax.lax.while_loop(cond, lambda s: S.step(s, spec), state)
+    return jax.lax.while_loop(cond, lambda s: S.step(s, spec, locked), state)
 
 
 def solve_batch_resumable(
@@ -133,6 +139,7 @@ def solve_batch_resumable(
     max_depth: Optional[int] = None,
     keep_checkpoint: bool = False,
     sharding=None,
+    locked: bool = False,
 ) -> S.SolveResult:
     """Solve a batch with periodic checkpoints; resume if one exists.
 
@@ -187,7 +194,7 @@ def solve_batch_resumable(
 
     while True:
         state = jax.block_until_ready(
-            _run_chunk(state, spec, chunk_iters, max_iters)
+            _run_chunk(state, spec, chunk_iters, max_iters, locked)
         )
         done = not bool(np.asarray(state.status == S.RUNNING).any())
         if done:

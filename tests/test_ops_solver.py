@@ -179,3 +179,52 @@ def test_staged_depth_overflow_retry():
     np.testing.assert_array_equal(
         np.asarray(s2.validations), np.asarray(f2.validations)
     )
+
+
+def test_locked_candidate_eliminations_sound():
+    """Pointing/claiming eliminations never remove the true solution's value
+    from a cell's candidate set, and the locked solve agrees with the plain
+    solve on certified-unique boards."""
+    import jax.numpy as jnp
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+    from sudoku_solver_distributed_tpu.ops.propagate import analyze
+
+    boards = generate_batch(32, 52, seed=61, unique=True)
+    plain = solve_batch(jnp.asarray(boards), SPEC_9)
+    assert bool(np.asarray(plain.solved).all())
+    solutions = np.asarray(plain.grid)
+
+    a = analyze(jnp.asarray(boards), SPEC_9, locked=True)
+    cand = np.asarray(a.cand)
+    empty = np.asarray(boards) == 0
+    sol_bit = np.where(empty, 1 << (solutions - 1), 0)
+    # every empty cell's candidate set still admits the unique solution
+    assert bool(((cand & sol_bit) == sol_bit)[empty].all())
+    # and locked eliminations actually fire somewhere on this corpus
+    plain_cand = np.asarray(analyze(jnp.asarray(boards), SPEC_9).cand)
+    assert (cand != plain_cand).any()
+
+    locked = solve_batch(jnp.asarray(boards), SPEC_9, locked_candidates=True)
+    assert bool(np.asarray(locked.solved).all())
+    np.testing.assert_array_equal(np.asarray(locked.grid), solutions)
+    # stronger propagation may not do MORE work
+    assert int(np.asarray(locked.guesses).sum()) <= int(
+        np.asarray(plain.guesses).sum()
+    )
+
+
+def test_locked_candidates_statuses_match_plain():
+    """UNSAT / bad-input verdicts are unchanged by locked eliminations."""
+    import jax.numpy as jnp
+
+    batch = np.zeros((3, 9, 9), np.int32)
+    batch[0, 0, 0] = batch[0, 0, 1] = 7       # duplicate clue → UNSAT
+    batch[1, 0, 0] = 10                        # out of range → UNSAT
+    # batch[2] empty → SOLVED
+    for flag in (False, True):
+        res = solve_batch(
+            jnp.asarray(batch), SPEC_9, locked_candidates=flag
+        )
+        st = np.asarray(res.status)
+        assert st[0] == UNSAT and st[1] == UNSAT and st[2] == SOLVED
