@@ -52,10 +52,11 @@ class SolverEngine:
         compacted lockstep loop; default) or "pallas" (ops/pallas_solver.py,
         the VMEM-resident per-block kernel; interpret mode is selected
         automatically off-TPU so tests run anywhere).
-      locked_candidates: locked-candidate (pointing + claiming)
-        eliminations in the solver's analysis sweeps — sound, ~30% faster
-        on hard corpora (ops/solver.py). Default: on for the xla backend;
-        unsupported by the pallas kernel (passing True with it raises).
+      locked_candidates: locked-set eliminations — locked candidates
+        (pointing + claiming) and naked pairs — in the solver's analysis
+        sweeps: sound, ~1.7× faster on hard corpora (ops/solver.py).
+        Default: on for the xla backend; unsupported by the pallas kernel
+        (passing True with it raises).
     """
 
     def __init__(

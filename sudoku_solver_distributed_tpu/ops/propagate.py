@@ -106,6 +106,46 @@ def _locked_candidate_elims(cand: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
     return out
 
 
+def _naked_pair_elims(cand: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
+    """(B, N, N) candidate-bit elimination masks from naked pairs.
+
+    Two cells of a unit sharing the same 2-value candidate set lock those
+    two values to those cells — every other cell of the unit drops them.
+    Detection is one (B, U, N, N) equality matrix per unit type (the only
+    pairwise tensor in the sweep; N² bools per unit, not per value), and a
+    cell that is itself half of a pair keeps its own set.
+    """
+    n, N = spec.box, spec.size
+    B = cand.shape[0]
+    pc2 = jax.lax.population_count(cand) == 2
+    eye = jnp.eye(N, dtype=bool)[None, None]
+    out = jnp.zeros_like(cand)
+    for mode in ("row", "col", "box"):
+        if mode == "row":
+            c, p2 = cand, pc2
+        elif mode == "col":
+            c, p2 = cand.swapaxes(1, 2), pc2.swapaxes(1, 2)
+        else:
+            c = _box_major(cand, spec)
+            p2 = _box_major(pc2, spec)
+        eqm = (
+            (c[:, :, :, None] == c[:, :, None, :])
+            & p2[:, :, :, None]
+            & p2[:, :, None, :]
+            & ~eye
+        )
+        has_twin = eqm.any(-1)                           # (B, U, N)
+        paired = jnp.where(has_twin, c, 0)
+        pairs_or = jnp.bitwise_or.reduce(paired, axis=2)  # (B, U)
+        elim = pairs_or[:, :, None] & ~paired             # (B, U, N)
+        if mode == "col":
+            elim = elim.swapaxes(1, 2)
+        elif mode == "box":
+            elim = _box_major(elim, spec)  # involution: maps back
+        out = out | elim
+    return out
+
+
 def _or_others(x: jnp.ndarray, axis: int) -> jnp.ndarray:
     """OR over the other n-1 entries along ``axis`` (size n), per entry.
 
@@ -138,10 +178,10 @@ def analyze(
 ) -> Analysis:
     """Fused sweep analysis of a (B, N, N) batch.
 
-    ``locked=True`` additionally applies locked-candidate eliminations
-    (pointing + claiming) to the candidate sets before single detection —
-    sound eliminations that strengthen each sweep at the cost of a few
-    extra bitmask ops.
+    ``locked=True`` additionally applies locked-set eliminations — locked
+    candidates (pointing + claiming) and naked pairs — to the candidate
+    sets before single detection: sound eliminations that strengthen each
+    sweep at the cost of a few extra bitmask ops.
 
     Contradiction covers: a duplicated value in a unit, an empty cell with an
     empty candidate set, and out-of-range cell values (anything outside
@@ -173,7 +213,10 @@ def analyze(
     empty = grid == 0
     cand = jnp.where(empty, ~used & jnp.int32(spec.full_mask), jnp.int32(0))
     if locked:
-        cand = cand & ~_locked_candidate_elims(cand, spec)
+        cand = cand & ~(
+            _locked_candidate_elims(cand, spec)
+            | _naked_pair_elims(cand, spec)
+        )
 
     # Hidden singles: a value with exactly one admitting cell in some unit is
     # forced at that cell — and "this cell admits v AND v has one admitting

@@ -215,8 +215,10 @@ def _make_racer(
 ):
     """Compile the shard_map race: lockstep DFS with per-iteration early exit.
 
-    Cached on (mesh, spec, max_iters, max_depth) — a fresh closure per call
-    would re-trace under jit on every frontier-routed request."""
+    Cached on (mesh, spec, max_iters, max_depth, locked) — a fresh closure
+    per call would re-trace under jit on every frontier-routed request;
+    warmup (engine.py) and serving must pass identical values to share the
+    compiled program."""
 
     from jax.sharding import PartitionSpec as P
 

@@ -228,3 +228,28 @@ def test_locked_candidates_statuses_match_plain():
         )
         st = np.asarray(res.status)
         assert st[0] == UNSAT and st[1] == UNSAT and st[2] == SOLVED
+
+
+def test_naked_pair_elimination_fires():
+    """Constructed case: two cells holding exactly {1,2} in one row must
+    strip 1 and 2 from every other cell of that row (and keep their own)."""
+    import jax.numpy as jnp
+
+    from sudoku_solver_distributed_tpu.ops.propagate import analyze
+
+    board = np.zeros((1, 9, 9), np.int32)
+    # row 0: cells 2..7 filled with 3..8 -> cells 0,1,8 empty.
+    board[0, 0, 2:8] = [3, 4, 5, 6, 7, 8]
+    # column clues remove 9 from cells (0,0) and (0,1) so both become {1,2};
+    # cell (0,8) keeps {1,2,9}
+    board[0, 1, 0] = 9
+    board[0, 2, 1] = 9
+    plain = analyze(jnp.asarray(board), SPEC_9)
+    locked = analyze(jnp.asarray(board), SPEC_9, locked=True)
+    pair = 0b11
+    assert int(plain.cand[0, 0, 0]) == pair
+    assert int(plain.cand[0, 0, 1]) == pair
+    assert int(plain.cand[0, 0, 8]) & pair == pair  # plain keeps 1,2
+    assert int(locked.cand[0, 0, 8]) & pair == 0    # pair strips them
+    assert int(locked.cand[0, 0, 8]) == 0b100000000  # only 9 remains
+    assert int(locked.cand[0, 0, 0]) == pair        # pair cells keep theirs
