@@ -183,9 +183,17 @@ def main_latency():
     # engine + HTTP + P2P bookkeeping), not the reference's simulated-work
     # sleeps, which -h scales (reference node.py:89-95)
     # BENCH_PLATFORM=cpu serves from the local CPU backend — the co-located-
-    # device proxy when the only TPU is behind a high-RTT tunnel
+    # device proxy when the only TPU is behind a high-RTT tunnel.
+    # BENCH_FRONTIER=N routes the /solve through the mesh-sharded frontier
+    # race (N speculative states per chip) instead of the bucket path.
     platform = os.environ.get("BENCH_PLATFORM")
     extra = ["--platform", platform] if platform else []
+    # "0" must mean off (the CLI's own convention) or the metric would be
+    # labeled frontier while the node serves the bucket path
+    frontier = os.environ.get("BENCH_FRONTIER")
+    frontier = frontier if frontier and int(frontier) > 0 else None
+    if frontier:
+        extra += ["--frontier", frontier]
     proc = subprocess.Popen(
         [
             sys.executable, os.path.join(repo, "node.py"),
@@ -235,10 +243,13 @@ def main_latency():
         times = np.asarray(times)
         p50 = float(np.percentile(times, 50))
         p95 = float(np.percentile(times, 95))
+        metric = "p50_solve_http_latency_readme9x9"
+        if frontier:
+            metric += "_frontier"
         print(
             json.dumps(
                 {
-                    "metric": "p50_solve_http_latency_readme9x9",
+                    "metric": metric,
                     "value": round(p50, 2),
                     "unit": "ms",
                     "vs_baseline": round(5.0 / p50, 4),
@@ -247,6 +258,7 @@ def main_latency():
         )
         print(
             f"# reps={reps} platform={platform or 'default'} "
+            f"frontier={frontier or 'off'} "
             f"p50={p50:.2f}ms p95={p95:.2f}ms "
             f"min={times.min():.2f}ms max={times.max():.2f}ms "
             f"(blocking HTTP; on a tunneled chip each request pays the "
