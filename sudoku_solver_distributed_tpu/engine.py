@@ -223,7 +223,7 @@ class SolverEngine:
 
             n_dev = self.frontier_mesh.devices.size
             target = n_dev * self.frontier_states_per_device
-            frontier.warm_seeding(self.spec, target)
+            frontier.warm_seeding(self.spec, target, self.locked_candidates)
             racer = frontier._make_racer(
                 self.frontier_mesh,
                 self.spec,

@@ -48,11 +48,11 @@ def _unsat_pad(spec: BoardSpec) -> np.ndarray:
 
 
 @lru_cache(maxsize=None)
-def _seed_jits(spec: BoardSpec):
-    """Per-spec jitted seeding helpers. Cached on the spec so repeated
+def _seed_jits(spec: BoardSpec, locked: bool = False):
+    """Per-(spec, locked) jitted seeding helpers. Cached so repeated
     ``seed_frontier`` calls (every frontier-routed ``/solve``) reuse the
     compiled programs instead of re-tracing fresh closures each request."""
-    analyze_j = jax.jit(partial(analyze, spec=spec))
+    analyze_j = jax.jit(partial(analyze, spec=spec, locked=locked))
     assign_j = jax.jit(
         lambda g, a: jnp.where((g == 0) & (a != 0), mask_to_value(a), g)
     )
@@ -78,6 +78,7 @@ def seed_frontier(
     *,
     target: int = 64,
     max_rounds: Optional[int] = None,
+    locked: bool = False,
 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Expand one board into ≥``target`` disjoint speculative states.
 
@@ -95,7 +96,7 @@ def seed_frontier(
         # each round either assigns singles (≤ cells of them) or splits
         max_rounds = spec.cells + 16
     states = np.asarray(board, np.int32)[None]
-    analyze_j, assign_j = _seed_jits(spec)
+    analyze_j, assign_j = _seed_jits(spec, locked)
     seed_dev = _seed_device()
     ctx = (
         jax.default_device(seed_dev)
@@ -183,11 +184,12 @@ def _seed_rounds(states, spec, target, max_rounds, analyze_j, assign_j):
     return states, None
 
 
-def warm_seeding(spec: BoardSpec, target: int) -> None:
+def warm_seeding(spec: BoardSpec, target: int, locked: bool = False) -> None:
     """Pre-compile the seeding programs for every pow2 state-batch shape up
     to ``pow2(target)``, on the seeding device — so a server's first
-    frontier-routed request pays no seeding compiles."""
-    analyze_j, assign_j = _seed_jits(spec)
+    frontier-routed request pays no seeding compiles. ``locked`` must match
+    what serving passes (the jit cache keys on it)."""
+    analyze_j, assign_j = _seed_jits(spec, locked)
     seed_dev = _seed_device()
     ctx = (
         jax.default_device(seed_dev)
@@ -296,7 +298,7 @@ def frontier_solve(
     target = n_dev * states_per_device
 
     board = np.asarray(board, np.int32)
-    states, early = seed_frontier(board, spec, target=target)
+    states, early = seed_frontier(board, spec, target=target, locked=locked)
     if early is not None:
         return early.tolist(), {"validations": 0, "seeded": len(states)}
 
