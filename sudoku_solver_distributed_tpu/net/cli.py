@@ -164,6 +164,7 @@ def main(argv=None) -> None:
             engine.spec,
             states_per_device=args.frontier,
             locked=engine.locked_candidates,
+            waves=engine.waves,
         )
         serving_loop.start()
         if serving_loop.is_leader:

@@ -69,7 +69,9 @@ def _mrv_cell(grid: jnp.ndarray, cand: jnp.ndarray):
     return cell, cand[b, cell]
 
 
-def _step(state: _State, spec: BoardSpec, locked: bool = False) -> _State:
+def _step(
+    state: _State, spec: BoardSpec, locked: bool = False, waves: int = 1
+) -> _State:
     B, C = state.grid.shape
     D = state.stack_mask.shape[1]
     N = spec.size
@@ -146,6 +148,33 @@ def _step(state: _State, spec: BoardSpec, locked: bool = False) -> _State:
     )
 
     depth = state.depth + do_branch.astype(jnp.int32) - bt_pop.astype(jnp.int32)
+    validations = state.validations + running.astype(jnp.int32)
+
+    # Extra propagation waves: re-analyze the merged grid and assign the
+    # newly forced singles, ``waves - 1`` times. Forced moves only — the
+    # DFS tree is unchanged, but each lockstep iteration advances the
+    # propagation chain several cells, amortizing the step's merge/stack
+    # machinery over multiple sweeps (measured 2026-07-30, hard-9x9 corpus,
+    # waves=2: 445 -> 291 iterations, ~+15% throughput). Boards that
+    # contradicted, solved, or have no singles pass through untouched.
+    for _ in range(waves - 1):
+        aw = analyze(grid.reshape(B, N, N), spec, locked=locked)
+        assign_w = aw.assign.reshape(B, C)
+        still_running = (new_status == RUNNING)
+        w = (
+            still_running
+            & ~aw.contradiction
+            & ~aw.solved
+            & (assign_w != 0).any(axis=1)
+        )
+        grid = jnp.where(
+            w[:, None],
+            jnp.where(assign_w != 0, mask_to_value(assign_w), grid),
+            grid,
+        )
+        # every still-running board paid this sweep's analysis, assignment
+        # or not — same counting rule as the base sweep above
+        validations = validations + still_running.astype(jnp.int32)
 
     return _State(
         grid=grid,
@@ -155,7 +184,7 @@ def _step(state: _State, spec: BoardSpec, locked: bool = False) -> _State:
         depth=depth,
         status=new_status,
         guesses=state.guesses + do_branch.astype(jnp.int32),
-        validations=state.validations + running.astype(jnp.int32),
+        validations=validations,
         iters=state.iters + 1,
     )
 
@@ -182,9 +211,11 @@ def init_state(
     )
 
 
-def step(state: _State, spec: BoardSpec, locked: bool = False) -> _State:
+def step(
+    state: _State, spec: BoardSpec, locked: bool = False, waves: int = 1
+) -> _State:
     """One lockstep solver iteration over the batch (public; see init_state)."""
-    return _step(state, spec, locked)
+    return _step(state, spec, locked, waves)
 
 
 def finalize_status(state: _State, spec: BoardSpec) -> _State:
@@ -236,7 +267,11 @@ def _write_boards(state: _State, sub: _State, count: int) -> _State:
 
 
 def _run_widened(
-    state: _State, spec: BoardSpec, max_iters: int, locked: bool = False
+    state: _State,
+    spec: BoardSpec,
+    max_iters: int,
+    locked: bool = False,
+    waves: int = 1,
 ) -> _State:
     """Race the pathological tail: restart each still-RUNNING board from its
     search root and explore all top-level candidates of its MRV cell as
@@ -303,7 +338,9 @@ def _run_widened(
     def cond(ws):
         return (~parents_done(ws)).any() & (ws.iters < max_iters)
 
-    w = jax.lax.while_loop(cond, lambda ws: _step(ws, spec, locked), w)
+    w = jax.lax.while_loop(
+        cond, lambda ws: _step(ws, spec, locked, waves), w
+    )
     w = finalize_status(w, spec)
 
     st = w.status.reshape(R, N)
@@ -355,6 +392,7 @@ def _run_compacted(
     max_iters: int,
     widen_after: int | None = None,
     locked: bool = False,
+    waves: int = 1,
 ) -> _State:
     """Run the lockstep loop with hierarchical active-board compaction.
 
@@ -380,7 +418,7 @@ def _run_compacted(
 
         if widen_after is None:
             return jax.lax.while_loop(
-                cond, lambda s: _step(s, spec, locked), state
+                cond, lambda s: _step(s, spec, locked, waves), state
             )
 
         grace_end = jnp.minimum(state.iters + widen_after, max_iters)
@@ -389,11 +427,11 @@ def _run_compacted(
             return running_of(s).any() & (s.iters < grace_end)
 
         state = jax.lax.while_loop(
-            grace_cond, lambda s: _step(s, spec, locked), state
+            grace_cond, lambda s: _step(s, spec, locked, waves), state
         )
         return jax.lax.cond(
             running_of(state).any(),
-            lambda s: _run_widened(s, spec, max_iters, locked),
+            lambda s: _run_widened(s, spec, max_iters, locked, waves),
             lambda s: s,
             state,
         )
@@ -404,7 +442,9 @@ def _run_compacted(
         # running.sum() > next_cap (≥ 64) subsumes running.any()
         return (s.iters < max_iters) & (running_of(s).sum() > next_cap)
 
-    state = jax.lax.while_loop(cond, lambda s: _step(s, spec, locked), state)
+    state = jax.lax.while_loop(
+        cond, lambda s: _step(s, spec, locked, waves), state
+    )
 
     # Stable sort: RUNNING boards (key 0) to the front, finished (key 1) after.
     perm = jnp.argsort((~running_of(state)).astype(jnp.int32), stable=True)
@@ -413,7 +453,9 @@ def _run_compacted(
     sub = jax.tree.map(
         lambda x: x[:next_cap] if x.ndim else x, permuted
     )
-    sub = _run_compacted(sub, caps[1:], spec, max_iters, widen_after, locked)
+    sub = _run_compacted(
+        sub, caps[1:], spec, max_iters, widen_after, locked, waves
+    )
     merged = _write_boards(permuted, sub, next_cap)
     return _take_boards(merged, inv)
 
@@ -435,6 +477,7 @@ def _retry_overflow(
     compact: bool,
     widen_after: int | None,
     locked: bool = False,
+    waves: int = 1,
 ) -> SolveResult:
     """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
 
@@ -455,7 +498,7 @@ def _retry_overflow(
         r2 = solve_batch(
             g2, spec, max_iters=max_iters, max_depth=depth,
             compact=compact, widen_after=widen_after,
-            locked_candidates=locked,
+            locked_candidates=locked, waves=waves,
         )
         return SolveResult(
             grid=jnp.where(need[:, None, None], r2.grid, res.grid),
@@ -480,6 +523,7 @@ def solve_batch(
     compact: bool = True,
     widen_after: int | None = None,
     locked_candidates: bool = False,
+    waves: int = 1,
 ) -> SolveResult:
     """Solve a batch of boards to completion (or proven unsatisfiability).
 
@@ -521,6 +565,15 @@ def solve_batch(
         matches the other backends (a different — equally valid — solution
         can be returned for multi-solution boards).
 
+      waves: propagation sweeps folded into each lockstep iteration
+        (default 1 = the classic step). With ``waves=2`` every iteration
+        re-analyzes the merged grid and assigns the next round of forced
+        singles — the DFS tree is unchanged (forced moves only) while the
+        step's merge/stack machinery amortizes over two sweeps; measured
+        2026-07-30 on the hard-9×9 corpus with locked sets: 445→291
+        iterations, ~+15% throughput. ``iters`` counts fused iterations;
+        ``validations`` still counts actual analysis sweeps.
+
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """
     if isinstance(max_depth, (tuple, list)):
@@ -528,12 +581,12 @@ def solve_batch(
         res = solve_batch(
             grid, spec, max_iters=max_iters, max_depth=depths[0],
             compact=compact, widen_after=widen_after,
-            locked_candidates=locked_candidates,
+            locked_candidates=locked_candidates, waves=waves,
         )
         for d in depths[1:]:
             res = _retry_overflow(
                 grid, res, spec, d, max_iters, compact, widen_after,
-                locked_candidates,
+                locked_candidates, waves,
             )
         return res
 
@@ -544,7 +597,7 @@ def solve_batch(
     if widen_after is not None and caps[-1] * spec.size > 8192:
         widen_after = None  # see docstring: bound the widened batch's memory
     state = _run_compacted(
-        state, caps, spec, max_iters, widen_after, locked_candidates
+        state, caps, spec, max_iters, widen_after, locked_candidates, waves
     )
     state = finalize_status(state, spec)
 

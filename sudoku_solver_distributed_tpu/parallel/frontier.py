@@ -214,6 +214,7 @@ def _make_racer(
     max_iters: int,
     max_depth: Optional[int],
     locked: bool = False,
+    waves: int = 1,
 ):
     """Compile the shard_map race: lockstep DFS with per-iteration early exit.
 
@@ -242,7 +243,7 @@ def _make_racer(
 
         def body(carry):
             st, _ = carry
-            st = S.step(st, spec, locked)
+            st = S.step(st, spec, locked, waves)
             local_hit = (st.status == S.SOLVED).any()
             found = jax.lax.psum(local_hit.astype(jnp.int32), "data") > 0
             return st, found
@@ -287,6 +288,7 @@ def frontier_solve(
     max_iters: int = DEFAULT_MAX_ITERS,
     max_depth: Optional[int] = None,
     locked: bool = False,
+    waves: int = 1,
 ) -> Tuple[Optional[list], dict]:
     """Solve one (hard) board by racing its search subtrees across the mesh.
 
@@ -320,7 +322,7 @@ def frontier_solve(
             _unsat_pad(spec), (total - len(states), spec.size, spec.size)
         )
         states = np.concatenate([states, pad], axis=0)
-    racer = _make_racer(mesh, spec, max_iters, max_depth, locked)
+    racer = _make_racer(mesh, spec, max_iters, max_depth, locked, waves)
     if len(mesh.devices.flatten()) > len(jax.local_devices()):
         # multi-host mesh (serving_loop.py): every host ran the same
         # deterministic seeding and holds the full identical states array;

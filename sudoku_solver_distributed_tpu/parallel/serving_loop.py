@@ -54,6 +54,7 @@ class FrontierServingLoop:
         states_per_device: int = 64,
         max_depth: Optional[int] = None,
         locked: bool = False,
+        waves: int = 1,
     ):
         import jax
 
@@ -62,6 +63,7 @@ class FrontierServingLoop:
         self.states_per_device = states_per_device
         self.max_depth = max_depth
         self.locked = locked  # must be identical on every host
+        self.waves = waves    # ditto
         self.is_leader = jax.process_index() == 0
         self._requests: queue.Queue = queue.Queue()
         self._results: queue.Queue = queue.Queue()
@@ -88,6 +90,7 @@ class FrontierServingLoop:
             states_per_device=self.states_per_device,
             max_depth=self.max_depth,
             locked=self.locked,
+            waves=self.waves,
         )
 
     def _run(self) -> None:
