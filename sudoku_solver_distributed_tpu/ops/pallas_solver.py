@@ -310,12 +310,87 @@ def _make_kernel(spec: BoardSpec, L: int, D: int, max_iters: int):
     return kernel
 
 
+# Per-block guess-stack VMEM budget (bytes) for the automatic staged-depth
+# hybrid below: the stack is the kernel's dominant allocation (DP×C_pad×block
+# int8), and half of a v5e core's 16 MB VMEM leaves room for the grids,
+# bitplanes and matmul operands beside it.
+_VMEM_STACK_BUDGET = 8 * 1024 * 1024
+
+
+def _stack_bytes(depth: int, spec: BoardSpec, block: int) -> int:
+    return _pad8(depth) * _pad8(spec.cells) * block
+
+
+def _fit_depth(spec: BoardSpec, block: int) -> int:
+    """Largest multiple-of-8 stack depth whose VMEM stack fits the budget."""
+    d = _VMEM_STACK_BUDGET // (_pad8(spec.cells) * block)
+    return max(8, (d // 8) * 8)
+
+
+def _retry_overflow_deep(
+    grid: jnp.ndarray,
+    res: SolveResult,
+    spec: BoardSpec,
+    depth: int,
+    block: int,
+    max_iters: int,
+    interpret: bool,
+) -> SolveResult:
+    """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
+
+    Mirror of ops.solver._retry_overflow for the pallas backend: the whole
+    retry sits behind a ``lax.cond`` on "any overflow", non-overflow lanes
+    are replaced by an instantly-UNSAT pad board, and counters accumulate
+    across stages. The deep stage runs the pallas kernel while its stack
+    fits the VMEM budget; past that it hands the boards to the XLA path
+    (ops/solver.py), whose guess stack streams from HBM — the full-depth
+    guarantee no VMEM-resident kernel can give (e.g. 25×25 at depth 625 is
+    a ~50 MB/block stack).
+    """
+    from .solver import merge_retry_result, pad_board
+
+    need = res.status == OVERFLOW
+
+    def do(_):
+        g2 = jnp.where(
+            need[:, None, None], grid.astype(jnp.int32), pad_board(spec)
+        )
+        r2 = _solve_stage(
+            g2, spec, depth, block, max_iters, interpret
+        )
+        return merge_retry_result(need, res, r2)
+
+    return jax.lax.cond(need.any(), do, lambda _: res, None)
+
+
+def _solve_stage(
+    grid: jnp.ndarray,
+    spec: BoardSpec,
+    depth: int,
+    block: int,
+    max_iters: int,
+    interpret: bool,
+) -> SolveResult:
+    """One staging level at a flat ``depth``: the pallas kernel while its
+    stack fits the VMEM budget, the XLA solver (HBM-streamed stack) past it.
+    locked_candidates/waves stay off in the fallback so both backends search
+    in the same order and staged runs return identical solutions."""
+    if _stack_bytes(depth, spec, block) <= _VMEM_STACK_BUDGET:
+        return solve_batch_pallas(
+            grid, spec, block=block, max_depth=depth,
+            max_iters=max_iters, interpret=interpret,
+        )
+    from .solver import solve_batch as solve_batch_xla
+
+    return solve_batch_xla(grid, spec, max_iters=max_iters, max_depth=depth)
+
+
 def solve_batch_pallas(
     grid: jnp.ndarray,
     spec: BoardSpec,
     *,
     block: int = 128,
-    max_depth: Optional[int] = None,
+    max_depth: Optional[int | tuple] = None,
     max_iters: int = 4096,
     interpret: bool = False,
 ) -> SolveResult:
@@ -329,14 +404,40 @@ def solve_batch_pallas(
     ``block`` is the lane width of one kernel instance: on real TPU it must
     be a multiple of 128 (Mosaic lane tiling); interpret mode takes any
     value.
+
+    ``max_depth`` may be a tuple to stage the stack depth exactly like the
+    XLA path (ops/solver.py): the batch first runs at depth[0] and OVERFLOW
+    boards rerun at each deeper stage behind a free ``lax.cond``. Stages
+    whose stack exceeds the per-block VMEM budget run on the XLA solver
+    instead (its stack streams from HBM), so e.g. ``(64, 625)`` on 25×25
+    keeps the kernel VMEM-resident for the common case with the full-depth
+    guarantee intact. Default (None): the spec's full depth, auto-staged as
+    ``(fit, full)`` when the full-depth stack would not fit VMEM — so 25×25
+    works out of the box instead of over-allocating ~50 MB/block.
     """
     B = grid.shape[0]
     N, C = spec.size, spec.cells
     CP = _pad8(C)
+    if max_depth is None and (
+        _stack_bytes(spec.max_depth, spec, block) > _VMEM_STACK_BUDGET
+    ):
+        max_depth = (_fit_depth(spec, block), spec.max_depth)
+    if isinstance(max_depth, (tuple, list)):
+        depths = tuple(max_depth)
+        # every stage — including the first — honors the VMEM budget
+        # (_solve_stage routes over-budget depths to the XLA solver); a
+        # too-big block can make even _fit_depth's floor of 8 over budget
+        res = _solve_stage(
+            grid.astype(jnp.int32), spec, depths[0], block, max_iters,
+            interpret,
+        )
+        for d in depths[1:]:
+            res = _retry_overflow_deep(
+                grid, res, spec, d, block, max_iters, interpret
+            )
+        return res
     # Same default depth budget as the XLA path (spec.max_depth) so the two
-    # backends report identical OVERFLOW verdicts. The per-block VMEM stack
-    # is D×C_pad×block int8 — ~1 MB for 9×9, ~8 MB for 16×16; for 25×25
-    # (~50 MB) pass an explicit smaller max_depth.
+    # backends report identical OVERFLOW verdicts.
     D = max_depth if max_depth is not None else spec.max_depth
     flat = grid.astype(jnp.int32).reshape(B, C)
     pad = (-B) % block

@@ -468,6 +468,37 @@ def _compaction_schedule(B: int) -> list:
     return caps
 
 
+def pad_board(spec: BoardSpec) -> jnp.ndarray:
+    """An instantly-UNSAT (N, N) board (two equal clues in one row): the
+    stand-in for lanes a staged retry must not re-solve — it dies in one
+    iteration, so a compaction loop drops it immediately."""
+    return jnp.zeros((spec.size, spec.size), jnp.int32).at[0, 0].set(1).at[
+        0, 1
+    ].set(1)
+
+
+def merge_retry_result(
+    need: jnp.ndarray, res: SolveResult, r2: SolveResult
+) -> SolveResult:
+    """Merge a deeper-stage rerun ``r2`` over the lanes ``need`` of ``res``.
+
+    The staging contract shared by both solver backends (this module's
+    ``_retry_overflow`` and the pallas kernel's ``_retry_overflow_deep``):
+    retried lanes take the rerun's grid/status, work counters accumulate
+    across stages, and ``iters`` (a batch-shared scalar) always sums.
+    """
+    return SolveResult(
+        grid=jnp.where(need[:, None, None], r2.grid, res.grid),
+        solved=jnp.where(need, r2.solved, res.solved),
+        status=jnp.where(need, r2.status, res.status),
+        guesses=jnp.where(need, res.guesses + r2.guesses, res.guesses),
+        validations=jnp.where(
+            need, res.validations + r2.validations, res.validations
+        ),
+        iters=res.iters + r2.iters,
+    )
+
+
 def _retry_overflow(
     grid: jnp.ndarray,
     res: SolveResult,
@@ -492,24 +523,15 @@ def _retry_overflow(
     need = res.status == OVERFLOW
 
     def do(_):
-        N = spec.size
-        pad = jnp.zeros((N, N), jnp.int32).at[0, 0].set(1).at[0, 1].set(1)
-        g2 = jnp.where(need[:, None, None], grid.astype(jnp.int32), pad)
+        g2 = jnp.where(
+            need[:, None, None], grid.astype(jnp.int32), pad_board(spec)
+        )
         r2 = solve_batch(
             g2, spec, max_iters=max_iters, max_depth=depth,
             compact=compact, widen_after=widen_after,
             locked_candidates=locked, waves=waves,
         )
-        return SolveResult(
-            grid=jnp.where(need[:, None, None], r2.grid, res.grid),
-            solved=jnp.where(need, r2.solved, res.solved),
-            status=jnp.where(need, r2.status, res.status),
-            guesses=jnp.where(need, res.guesses + r2.guesses, res.guesses),
-            validations=jnp.where(
-                need, res.validations + r2.validations, res.validations
-            ),
-            iters=res.iters + r2.iters,
-        )
+        return merge_retry_result(need, res, r2)
 
     return jax.lax.cond(need.any(), do, lambda _: res, None)
 

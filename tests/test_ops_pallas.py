@@ -86,3 +86,74 @@ def test_pallas_16x16_matches_xla():
     )
     assert bool(np.asarray(res.solved).all()), np.asarray(res.status)
     np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(ref.grid))
+
+
+def test_pallas_staged_depth_overflow_retry():
+    """Tuple max_depth: stage-0 overflow reruns at the deeper stage behind a
+    lax.cond, matching the flat-depth run exactly (ops.solver's staging
+    contract, mirrored for the kernel)."""
+    batch = np.zeros((2, 9, 9), np.int32)
+    batch[0] = generate_batch(1, 30, seed=36)[0]   # shallow: no retry needed
+    # batch[1] stays empty — needs ~47 frames, certain stage-0 overflow at 8
+    flat = _pallas(batch, block=2, max_depth=81)
+    staged = _pallas(batch, block=2, max_depth=(8, 81))
+    assert bool(np.asarray(staged.solved).all()), np.asarray(staged.status)
+    np.testing.assert_array_equal(
+        np.asarray(staged.grid), np.asarray(flat.grid)
+    )
+    # the overflowing board's counters accumulate across stages
+    assert int(staged.guesses[1]) >= int(flat.guesses[1])
+
+
+def test_pallas_staged_depth_xla_fallback(monkeypatch):
+    """A stage whose stack exceeds the VMEM budget runs on the XLA solver
+    (HBM-streamed stack) — the 25×25 full-depth story, exercised at 9×9 by
+    shrinking the budget."""
+    from sudoku_solver_distributed_tpu.ops import pallas_solver as ps
+
+    batch = np.zeros((1, 9, 9), np.int32)          # deepest 9×9 search
+    # stage-0 depth 8 fits; the deep stage (81) must not
+    monkeypatch.setattr(
+        ps, "_VMEM_STACK_BUDGET", ps._stack_bytes(8, SPEC_9, 1)
+    )
+    res = ps.solve_batch_pallas(
+        jnp.asarray(batch, jnp.int32), SPEC_9, block=1,
+        max_depth=(8, 81), interpret=True,
+    )
+    assert int(res.status[0]) == SOLVED
+    ref = solve_batch(jnp.asarray(batch), SPEC_9)
+    np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(ref.grid))
+
+
+def test_pallas_auto_stages_oversized_default_depth(monkeypatch):
+    """Default depth auto-stages when the spec's full-depth stack would not
+    fit VMEM: 25×25 at block=128 is the motivating case (a ~50 MB/block
+    stack). The decision arithmetic is checked at 25×25; the rewrite path
+    itself (None → staged tuple → solve) is executed at 9×9 under a shrunk
+    budget, where even the auto-picked first stage is over budget and routes
+    to the XLA solver — the worst case the staging must survive."""
+    from sudoku_solver_distributed_tpu.ops import spec_for_size
+    from sudoku_solver_distributed_tpu.ops import pallas_solver as ps
+
+    spec25 = spec_for_size(25)
+    assert ps._stack_bytes(spec25.max_depth, spec25, 128) \
+        > ps._VMEM_STACK_BUDGET
+    fit = ps._fit_depth(spec25, 128)
+    assert fit % 8 == 0
+    assert ps._stack_bytes(fit, spec25, 128) <= ps._VMEM_STACK_BUDGET
+    # 9×9/16×16 at their defaults stay flat (no staging, no behavior change)
+    assert ps._stack_bytes(SPEC_9.max_depth, SPEC_9, 128) \
+        <= ps._VMEM_STACK_BUDGET
+    spec16 = spec_for_size(16)
+    assert ps._stack_bytes(spec16.max_depth, spec16, 128) \
+        <= ps._VMEM_STACK_BUDGET
+
+    # run the auto-stage rewrite for real: budget below even depth-8 stacks
+    monkeypatch.setattr(ps, "_VMEM_STACK_BUDGET", 1)
+    batch = np.zeros((1, 9, 9), np.int32)          # deepest 9×9 search
+    res = ps.solve_batch_pallas(
+        jnp.asarray(batch, jnp.int32), SPEC_9, block=1, interpret=True
+    )
+    assert int(res.status[0]) == SOLVED
+    ref = solve_batch(jnp.asarray(batch), SPEC_9)
+    np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(ref.grid))
