@@ -94,7 +94,7 @@ def main():
     solve = jax.jit(
         lambda g: solve_batch(
             g, spec, max_depth=max_depth, max_iters=_MAX_ITERS[BENCH_SIZE],
-            locked_candidates=True, waves=2
+            locked_candidates=True, waves=3
         )
     )
 

@@ -98,10 +98,11 @@ class SolverEngine:
             )
         self.locked_candidates = locked_candidates
         # propagation sweeps fused per lockstep iteration (ops/solver.py);
-        # default 2 for the xla backend (measured ~+15%), 1 for pallas
-        # (the kernel has no wave support)
+        # default 3 for the xla backend (hard-9×9 corpus on the v5e,
+        # 2026-07-30: waves=2 258k → waves=3 277k puzzles/s/chip, iters
+        # 291→238; waves=4 plateaus), 1 for pallas (no wave support)
         if waves is None:
-            waves = 2 if backend == "xla" else 1
+            waves = 3 if backend == "xla" else 1
         if waves != 1 and backend == "pallas":
             raise ValueError(
                 "waves is not supported by the pallas kernel"
