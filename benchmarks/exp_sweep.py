@@ -1,0 +1,80 @@
+"""Solver-config sweep on the bench corpus: one process, one TPU claim.
+
+Usage (from the repo root; each config is a Python-literal dict of
+``solve_batch`` keyword overrides):
+
+    python benchmarks/exp_sweep.py \
+        "{'max_depth': (32, 81), 'waves': 3}" \
+        "{'max_depth': (24, 81), 'waves': 3}"
+
+With no arguments, runs the current bench default plus its one-step
+neighborhood (waves ±1, shallower/deeper first stage).
+
+All configs run sequentially inside this single process so the tunneled
+chip is claimed once and the compile cache is shared — do NOT launch
+several of these concurrently, and do not wrap in a tight ``timeout``: a
+killed mid-compile process wedges the pool-side claim for minutes
+(ROADMAP, round-1/2 incidents). Sustained timing matches bench.py:
+back-to-back async dispatch, one trailing sync.
+"""
+
+import ast
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sudoku_solver_distributed_tpu.ops import solve_batch, spec_for_size
+
+SIZE = int(os.environ.get("BENCH_SIZE", "9"))
+_DEFAULT_BATCH = {9: 16384, 16: 2048, 25: 128}
+REPEATS = int(os.environ.get("BENCH_REPEATS", "5"))
+
+DEFAULTS = [
+    {"max_depth": (32, 81), "waves": 3, "locked_candidates": True},
+    {"max_depth": (32, 81), "waves": 2, "locked_candidates": True},
+    {"max_depth": (32, 81), "waves": 4, "locked_candidates": True},
+    {"max_depth": (24, 81), "waves": 3, "locked_candidates": True},
+    {"max_depth": (48, 81), "waves": 3, "locked_candidates": True},
+]
+
+
+def main():
+    spec = spec_for_size(SIZE)
+    batch = _DEFAULT_BATCH[SIZE]
+    corpus = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"corpus_{SIZE}x{SIZE}_hard_{batch}.npz",
+    )
+    boards = np.load(corpus)["boards"]
+    dev = jnp.asarray(boards)
+    B = boards.shape[0]
+
+    configs = (
+        [ast.literal_eval(a) for a in sys.argv[1:]]
+        if len(sys.argv) > 1
+        else DEFAULTS
+    )
+    for cfg in configs:
+        kw = {"locked_candidates": True, **cfg}
+        f = jax.jit(lambda g, kw=kw: solve_batch(g, spec, max_iters=65536, **kw))
+        r = jax.block_until_ready(f(dev))
+        assert bool(np.asarray(r.solved).all()), f"unsolved boards under {cfg}"
+        t0 = time.perf_counter()
+        outs = [f(dev) for _ in range(REPEATS)]
+        jax.block_until_ready(outs[-1])
+        sus = (time.perf_counter() - t0) / REPEATS
+        print(
+            f"{cfg}  sustained={sus * 1000:.1f}ms  pps={B / sus:,.0f}  "
+            f"iters={int(r.iters)}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
