@@ -27,6 +27,8 @@ def make_sharded_solver(
     *,
     max_depth: Optional[int] = None,
     max_iters: int = 4096,
+    locked_candidates: bool = True,
+    waves: int = 3,
 ):
     """Compile a mesh-sharded batch solver.
 
@@ -36,6 +38,10 @@ def make_sharded_solver(
     dict of scalar counters (solved count, validation sweeps, guesses) reduced
     with ``psum`` over the mesh — the device-side analog of the reference's
     stats gossip aggregation (reference node.py:264-328).
+
+    ``locked_candidates``/``waves`` default to the measured single-chip
+    winners (ops/solver.py; v5e 2026-07-30) so the sharded path runs the
+    same optimized kernel per shard as the serving engine.
     """
     data_spec = P("data")
 
@@ -50,7 +56,10 @@ def make_sharded_solver(
         check_vma=False,
     )
     def _solve_shard(grids):
-        res = solve_batch(grids, spec, max_iters=max_iters, max_depth=max_depth)
+        res = solve_batch(
+            grids, spec, max_iters=max_iters, max_depth=max_depth,
+            locked_candidates=locked_candidates, waves=waves,
+        )
         stats = {
             "solved": jax.lax.psum(res.solved.sum(), "data"),
             "validations": jax.lax.psum(res.validations.sum(), "data"),
