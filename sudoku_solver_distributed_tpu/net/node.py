@@ -53,6 +53,7 @@ class P2PNode:
         mesh_peer_count: int = 0,
         failure_timeout: float = FAILURE_TIMEOUT_S,
         metrics=None,
+        fault_injector=None,
     ):
         self.host = host
         self.port = port
@@ -113,6 +114,11 @@ class P2PNode:
         # request-latency recorder fed by the HTTP layer (utils/profiling.py);
         # optional so bare nodes pay nothing
         self.metrics = metrics
+        # chaos-testing hook (utils/faults.FaultInjector): when set, every
+        # outbound datagram is planned through it — dropped, delayed, or
+        # duplicated deterministically. The fault tooling the reference
+        # lacks (SURVEY.md §5); None costs nothing.
+        self.fault_injector = fault_injector
 
     # -- counters ----------------------------------------------------------
     # `solved` counts one per successful master solve (reference node.py:468
@@ -133,6 +139,20 @@ class P2PNode:
 
     # -- transport ---------------------------------------------------------
     def send(self, address, msg: wire.Msg) -> None:
+        if self.fault_injector is not None:
+            for planned, delay in self.fault_injector.plan(msg):
+                if delay > 0:
+                    t = threading.Timer(
+                        delay, self._raw_send, (address, planned)
+                    )
+                    t.daemon = True
+                    t.start()
+                else:
+                    self._raw_send(address, planned)
+            return
+        self._raw_send(address, msg)
+
+    def _raw_send(self, address, msg: wire.Msg) -> None:
         try:
             self.sock.sendto(wire.encode_msg(msg), address)
         except OSError as e:
@@ -381,7 +401,15 @@ class P2PNode:
                 requeued_none = False
                 while self.solution_queue:
                     row, col, value, peer = self.solution_queue.popleft()
-                    self.active_tasks.pop(peer, None)
+                    # Retire the peer's assignment only if this answer is
+                    # for it: a duplicated or deadline-late datagram about
+                    # an older cell must not knock the peer's *current*
+                    # in-flight task out of active_tasks (that silently
+                    # loses the cell and fails the solve — caught by
+                    # tests/test_faults.py duplicate-injection).
+                    cur = self.active_tasks.get(peer)
+                    if cur is not None and (cur[0], cur[1]) == (row, col):
+                        del self.active_tasks[peer]
                     if value is None:
                         requeued_none = True
                         continue

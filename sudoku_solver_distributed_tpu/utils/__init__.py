@@ -1,6 +1,13 @@
-"""Host-side utilities: handicap rate limiting, board rendering, logging."""
+"""Host-side utilities: handicap rate limiting, board rendering, fault
+injection, logging."""
 
+from .faults import FaultInjector
 from .ratelimit import HandicapLimiter
 from .render import render_board, render_board_highlight_zeros
 
-__all__ = ["HandicapLimiter", "render_board", "render_board_highlight_zeros"]
+__all__ = [
+    "FaultInjector",
+    "HandicapLimiter",
+    "render_board",
+    "render_board_highlight_zeros",
+]

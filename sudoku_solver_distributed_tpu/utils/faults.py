@@ -1,0 +1,95 @@
+"""Deterministic wire-fault injection for chaos-testing the P2P plane.
+
+The reference's failure story is graceful-only — a lost or delayed datagram
+simply stalls it (fire-and-forget UDP, no acks/retries, reference
+node.py:177-191), and it ships no tooling to provoke that situation
+(SURVEY.md §5: "no fault injection tooling"). This injector is that missing
+tool for the rebuilt stack: it sits on a node's *outbound* transport seam
+(``P2PNode.send``) and drops, delays, or duplicates selected message types
+under a seeded RNG, so tests can prove the recovery machinery — task
+deadlines + requeue, heartbeat crash detection, deletion flooding — actually
+recovers, deterministically.
+
+Outbound-only is sufficient: a datagram dropped by the sender is
+indistinguishable to the cluster from one dropped in flight or by the
+receiver.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class FaultInjector:
+    """Plan wire faults per outgoing message, deterministically.
+
+    Args:
+      drop: ``{msg_type: probability}`` — drop matching messages with the
+        given probability (seeded RNG, so a fixed seed gives a fixed drop
+        sequence).
+      drop_first: ``{msg_type: n}`` — drop the first ``n`` messages of that
+        type unconditionally, *before* the probabilistic rule applies. The
+        fully deterministic knob for tests ("lose the first two task
+        dispatches").
+      delay_s: ``{msg_type: seconds}`` — deliver matching messages late
+        (reordering simulation: later sends of other types overtake them).
+      duplicate: ``{msg_type: probability}`` — send matching messages twice
+        (UDP duplicates; receivers must be idempotent, as the reference's
+        stale-answer handling already assumes).
+      seed: RNG seed shared by the probabilistic rules.
+
+    A message type absent from every rule passes through untouched. Counters
+    (``dropped``/``delayed``/``duplicated`` per type) are thread-safe and
+    readable at any time.
+    """
+
+    def __init__(
+        self,
+        drop: Optional[Dict[str, float]] = None,
+        drop_first: Optional[Dict[str, int]] = None,
+        delay_s: Optional[Dict[str, float]] = None,
+        duplicate: Optional[Dict[str, float]] = None,
+        seed: int = 0,
+    ):
+        self.drop = dict(drop or {})
+        self.delay_s = dict(delay_s or {})
+        self.duplicate = dict(duplicate or {})
+        self._drop_first = dict(drop_first or {})
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.dropped: Dict[str, int] = {}
+        self.delayed: Dict[str, int] = {}
+        self.duplicated: Dict[str, int] = {}
+
+    def plan(self, msg: dict) -> List[Tuple[dict, float]]:
+        """The (message, delay_seconds) sends to actually perform for
+        ``msg`` — ``[]`` when dropped, two entries when duplicated."""
+        mtype = msg.get("type", "")
+        with self._lock:
+            remaining = self._drop_first.get(mtype, 0)
+            if remaining > 0:
+                self._drop_first[mtype] = remaining - 1
+                self.dropped[mtype] = self.dropped.get(mtype, 0) + 1
+                return []
+            if self._rng.random() < self.drop.get(mtype, 0.0):
+                self.dropped[mtype] = self.dropped.get(mtype, 0) + 1
+                return []
+            delay = self.delay_s.get(mtype, 0.0)
+            if delay > 0:
+                self.delayed[mtype] = self.delayed.get(mtype, 0) + 1
+            out = [(msg, delay)]
+            if self._rng.random() < self.duplicate.get(mtype, 0.0):
+                self.duplicated[mtype] = self.duplicated.get(mtype, 0) + 1
+                out.append((msg, delay))
+            return out
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        """Snapshot of per-type fault counters (for tests and operators)."""
+        with self._lock:
+            return {
+                "dropped": dict(self.dropped),
+                "delayed": dict(self.delayed),
+                "duplicated": dict(self.duplicated),
+            }

@@ -1,0 +1,130 @@
+"""Wire-fault injection: the chaos tooling the reference lacks.
+
+The reference's UDP plane is fire-and-forget (reference node.py:177-191) —
+a lost task dispatch stalls its solve forever, and nothing in its repo can
+even provoke that case. Here ``utils.faults.FaultInjector`` plugs into the
+node's outbound transport seam and these tests prove the recovery machinery
+(task deadlines + requeue, duplicate-answer idempotence) under injected
+loss, deterministically.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import generate_batch
+from sudoku_solver_distributed_tpu.net import node as nodemod
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.utils import FaultInjector
+
+
+def free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1,))
+    eng.warmup()
+    return eng
+
+
+def start_pair(engine, master_faults=None, worker_faults=None):
+    """Two-node cluster: [master, worker], each optionally fault-injected."""
+    nodes = []
+    anchor = None
+    for faults in (master_faults, worker_faults):
+        port = free_port()
+        node = P2PNode(
+            "127.0.0.1",
+            port,
+            anchor_node=anchor,
+            handicap=0.0,
+            engine=engine,
+            fault_injector=faults,
+        )
+        if anchor is None:
+            anchor = f"127.0.0.1:{port}"
+        nodes.append(node)
+    for node in nodes:
+        threading.Thread(target=node.run, daemon=True).start()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if all(len(n.membership.total_peers()) == 1 for n in nodes):
+            return nodes
+        time.sleep(0.05)
+    raise AssertionError("pair did not converge")
+
+
+def stop(nodes):
+    for n in nodes:
+        n.shutdown_flag = True
+        n.sock.close()
+
+
+def board_with_holes(holes, seed):
+    return generate_batch(1, holes, seed=seed, unique=True)[0].tolist()
+
+
+def test_injector_deterministic_and_counted():
+    msgs = [{"type": "solve"}] * 6 + [{"type": "stats"}] * 4
+    a = FaultInjector(drop={"solve": 0.5}, duplicate={"stats": 0.5}, seed=7)
+    b = FaultInjector(drop={"solve": 0.5}, duplicate={"stats": 0.5}, seed=7)
+    plans_a = [len(a.plan(m)) for m in msgs]
+    plans_b = [len(b.plan(m)) for m in msgs]
+    assert plans_a == plans_b  # same seed, same fault sequence
+    counts = a.counts()
+    assert counts["dropped"].get("solve", 0) == plans_a[:6].count(0)
+    assert counts["duplicated"].get("stats", 0) == plans_a[6:].count(2)
+    # untouched types pass through exactly once
+    assert a.plan({"type": "connect"}) == [({"type": "connect"}, 0.0)]
+
+
+def test_lost_task_dispatches_recovered_by_deadline(engine, monkeypatch):
+    """The master's first two `solve` dispatches vanish; the task deadline
+    requeues the cell and the solve still completes (the reference would
+    wait forever — its dispatch has no deadline, reference node.py:427-475)."""
+    monkeypatch.setattr(nodemod, "TASK_DEADLINE_S", 0.4)
+    faults = FaultInjector(drop_first={"solve": 2})
+    nodes = start_pair(engine, master_faults=faults)
+    try:
+        solution = nodes[0].peer_sudoku_solve(board_with_holes(3, seed=41))
+        assert solution is not None
+        assert all(all(v != 0 for v in row) for row in solution)
+        assert faults.counts()["dropped"]["solve"] == 2
+    finally:
+        stop(nodes)
+
+
+def test_duplicated_solutions_are_idempotent(engine):
+    """Every worker answer arrives twice (UDP duplicate); the master's
+    stale-answer handling must fold each cell exactly once."""
+    faults = FaultInjector(duplicate={"solution": 1.0})
+    nodes = start_pair(engine, worker_faults=faults)
+    try:
+        solution = nodes[0].peer_sudoku_solve(board_with_holes(4, seed=42))
+        assert solution is not None
+        assert all(all(v != 0 for v in row) for row in solution)
+        assert faults.counts()["duplicated"].get("solution", 0) >= 1
+    finally:
+        stop(nodes)
+
+
+def test_delayed_stats_do_not_false_positive_crash_detector(engine):
+    """Heartbeat datagrams delayed by less than the failure timeout must not
+    get a live peer pruned as crashed."""
+    faults = FaultInjector(delay_s={"stats": 0.3})
+    nodes = start_pair(engine, worker_faults=faults)
+    try:
+        time.sleep(3.0)  # several heartbeat periods under delay
+        assert len(nodes[0].membership.total_peers()) == 1
+        assert faults.counts()["delayed"].get("stats", 0) >= 1
+    finally:
+        stop(nodes)
