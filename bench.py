@@ -92,9 +92,11 @@ def main():
     # 2026-07-29 on the v5e: 9×9 +25%, 16×16 +7%, 25×25 neutral)
     max_depth = {9: (32, 81), 16: (64, 256), 25: None}[BENCH_SIZE]
     # fused propagation waves per lockstep iteration: per-size measured
-    # winners (v5e 2026-07-30: 9×9 waves=3 = 277k pps vs 258k at 2;
-    # 16×16/25×25 measured separately — see ROADMAP)
-    waves = {9: 3, 16: 3, 25: 3}[BENCH_SIZE]
+    # winners (v5e 2026-07-30: 9×9 waves=3 = 277k pps vs 258k at 2 and
+    # waves=4 plateau). 16×16/25×25 stay at the configuration their
+    # recorded ROADMAP numbers were measured with (waves=1) until a
+    # per-size sweep (benchmarks/exp_sweep.py) says otherwise.
+    waves = {9: 3, 16: 1, 25: 1}[BENCH_SIZE]
     solve = jax.jit(
         lambda g: solve_batch(
             g, spec, max_depth=max_depth, max_iters=_MAX_ITERS[BENCH_SIZE],
