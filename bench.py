@@ -13,6 +13,11 @@ Modes (BENCH_MODE env var):
     Note: through a tunneled TPU each blocking request pays the tunnel RTT
     (~70 ms here); p95/min and the request breakdown go to stderr so the
     artifact records both the serving-stack cost and the link cost.
+  farm — the reference's flagship multi-node scenario on its own terms:
+    4 CLI node processes, anchor join, a 5-hole 9×9 posted to a non-anchor
+    master; warm p50 in ms vs the reference's measured 180 ms (which
+    returned an incomplete board — completeness is asserted here;
+    SURVEY.md §3.2). vs_baseline = 180/p50.
 
 The reference publishes no benchmark numbers (BASELINE.md); its measured
 equivalent is ~0.006 puzzles/s on the README 8-clue board (168.4 s, single
@@ -280,8 +285,135 @@ def main_latency():
             proc.wait()
 
 
+def main_farm():
+    """4-node task-farm benchmark: the reference's flagship path, its rules.
+
+    The reference's only multi-node measurement is a 4-process localhost
+    farm solving a 5-hole 9×9 through `/solve` — 0.18 s, and the returned
+    board had an unsolved cell (SURVEY.md §3.2 [verified live]). This mode
+    reproduces that exact scenario on this stack — 4 CLI node processes,
+    anchor join, the request posted to a NON-anchor node (every node can be
+    master, SURVEY.md) — and reports warm p50 with completeness asserted on
+    every reply. vs_baseline = 180 ms / p50: ≥1.0 beats the reference's
+    incomplete-board time with complete boards.
+    """
+    import subprocess
+    import urllib.request
+
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    n_nodes = int(os.environ.get("BENCH_FARM_NODES", "4"))
+    reps = int(os.environ.get("BENCH_FARM_REPS", "20"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base = 19000 + os.getpid() % 600
+    http_ports = [base + i for i in range(n_nodes)]
+    udp_ports = [p - 1000 for p in http_ports]
+    platform = os.environ.get("BENCH_PLATFORM")
+    extra = ["--platform", platform] if platform else []
+
+    board = generate_batch(1, 5, seed=180, unique=True)[0].tolist()
+    body = json.dumps({"sudoku": board}).encode()
+    target = http_ports[1]  # non-anchor master, the SURVEY-verified flow
+
+    def post_solve(timeout=300.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{target}/solve",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            payload = json.loads(r.read())
+        return (time.perf_counter() - t0) * 1e3, payload
+
+    procs = []
+    try:
+        for i in range(n_nodes):
+            cmd = [
+                sys.executable, os.path.join(repo, "node.py"),
+                "-p", str(http_ports[i]), "-s", str(udp_ports[i]), "-h", "0",
+            ] + extra
+            if i > 0:
+                cmd += ["-a", f"localhost:{udp_ports[0]}"]
+            procs.append(
+                subprocess.Popen(
+                    cmd, cwd=repo,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                )
+            )
+            time.sleep(0.3)  # anchor first; joiners flood in join order
+
+        # convergence: the master-to-be sees all n-1 peers at /network
+        deadline = time.time() + 240
+        while True:
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError("a node exited before serving")
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{target}/network", timeout=2
+                ) as r:
+                    view = json.loads(r.read())
+                ids = set(view)
+                for vs in view.values():
+                    ids.update(vs)
+                if len(ids) >= n_nodes:
+                    break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError("farm did not converge")
+            time.sleep(0.5)
+
+        # warm: every worker compiles its engine on first dispatch
+        fast = 0
+        while fast < 2 and time.time() < deadline:
+            ms, _ = post_solve()
+            fast = fast + 1 if ms < 500 else 0
+
+        times = []
+        for _ in range(reps):
+            ms, payload = post_solve()
+            assert all(
+                all(v != 0 for v in row) for row in payload
+            ), "farm returned an incomplete board"
+            times.append(ms)
+        times = np.asarray(times)
+        p50 = float(np.percentile(times, 50))
+        print(
+            json.dumps(
+                {
+                    "metric": f"p50_solve_http_{n_nodes}node_farm_5hole9x9",
+                    "value": round(p50, 2),
+                    "unit": "ms",
+                    "vs_baseline": round(180.0 / p50, 4),
+                }
+            )
+        )
+        print(
+            f"# nodes={n_nodes} reps={reps} platform={platform or 'default'} "
+            f"p50={p50:.2f}ms p95={float(np.percentile(times, 95)):.2f}ms "
+            f"min={times.min():.2f}ms (reference: 180 ms with an unsolved "
+            f"cell left on the board; completeness asserted here)",
+            file=sys.stderr,
+        )
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
 if __name__ == "__main__":
-    if os.environ.get("BENCH_MODE", "throughput") == "latency":
+    mode = os.environ.get("BENCH_MODE", "throughput")
+    if mode == "latency":
         main_latency()
+    elif mode == "farm":
+        main_farm()
     else:
         main()
