@@ -81,6 +81,15 @@ def _load_corpus():
 
 def main():
     import jax
+
+    # BENCH_PLATFORM reroutes throughput runs (e.g. =cpu for smoke tests);
+    # the config route is the only one that works pre-init here — this
+    # environment's sitecustomize re-exports JAX_PLATFORMS over caller env
+    # vars (see __graft_entry__._ensure_devices).
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -310,7 +319,10 @@ def main_farm():
     base = 19000 + os.getpid() % 600
     http_ports = [base + i for i in range(n_nodes)]
     udp_ports = [p - 1000 for p in http_ports]
-    platform = os.environ.get("BENCH_PLATFORM")
+    # default cpu: n node processes must not each claim the (single,
+    # pooled) accelerator — on a one-claim tunnel they would serialize or
+    # wedge (docs/OPERATIONS.md). Export BENCH_PLATFORM= to override.
+    platform = os.environ.get("BENCH_PLATFORM", "cpu")
     extra = ["--platform", platform] if platform else []
 
     board = generate_batch(1, 5, seed=180, unique=True)[0].tolist()

@@ -1,0 +1,78 @@
+"""Smoke tests for bench.py — the driver's artifact generator.
+
+The driver runs ``python bench.py`` at the end of every round and records
+the one-line JSON verbatim; a syntax error or broken mode there would void
+the round's perf artifact, so each mode is exercised end-to-end here (tiny
+reps, cpu platform, generated-on-demand corpora).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(env_extra, timeout=480):
+    env = dict(os.environ, **env_extra)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert len(json_lines) == 1, proc.stdout
+    return json.loads(json_lines[0]), proc.stderr
+
+
+def check_artifact(artifact):
+    assert set(artifact) == {"metric", "value", "unit", "vs_baseline"}
+    assert artifact["value"] > 0 and artifact["vs_baseline"] > 0
+
+
+def test_throughput_mode_smoke():
+    """Tiny corpus (generated + cached on first run) through the default
+    mode; the JSON line must carry the driver's exact four keys."""
+    artifact, stderr = run_bench(
+        {
+            "BENCH_BATCH": "64",
+            "BENCH_REPEATS": "2",
+            "BENCH_PLATFORM": "cpu",
+        }
+    )
+    check_artifact(artifact)
+    assert artifact["metric"] == "puzzles_per_sec_per_chip_hard9x9"
+    assert artifact["unit"] == "puzzles/s/chip"
+
+
+def test_latency_mode_smoke():
+    artifact, stderr = run_bench(
+        {
+            "BENCH_MODE": "latency",
+            "BENCH_PLATFORM": "cpu",
+            "BENCH_LATENCY_REPS": "5",
+        }
+    )
+    check_artifact(artifact)
+    assert artifact["metric"] == "p50_solve_http_latency_readme9x9"
+    assert artifact["unit"] == "ms"
+
+
+def test_farm_mode_smoke():
+    artifact, stderr = run_bench(
+        {
+            "BENCH_MODE": "farm",
+            "BENCH_FARM_REPS": "3",
+            "BENCH_FARM_NODES": "3",
+        }
+    )
+    check_artifact(artifact)
+    assert artifact["metric"] == "p50_solve_http_3node_farm_5hole9x9"
+    assert "complete" in stderr or "completeness" in stderr
