@@ -7,8 +7,9 @@ Usage (from the repo root; each config is a Python-literal dict of
         "{'max_depth': (32, 81), 'waves': 3}" \
         "{'max_depth': (24, 81), 'waves': 3}"
 
-With no arguments, runs the current bench default plus its one-step
-neighborhood (waves ±1, shallower/deeper first stage).
+With no arguments, runs the current bench default, its light-waves
+variants (singles-only extra sweeps), and shallower/deeper first-stage
+depths.
 
 All configs run sequentially inside this single process so the tunneled
 chip is claimed once and the compile cache is shared — do NOT launch
@@ -37,8 +38,8 @@ REPEATS = int(os.environ.get("BENCH_REPEATS", "5"))
 
 DEFAULTS = [
     {"max_depth": (32, 81), "waves": 3, "locked_candidates": True},
-    {"max_depth": (32, 81), "waves": 2, "locked_candidates": True},
-    {"max_depth": (32, 81), "waves": 4, "locked_candidates": True},
+    {"max_depth": (32, 81), "waves": 3, "light_waves": True},
+    {"max_depth": (32, 81), "waves": 4, "light_waves": True},
     {"max_depth": (24, 81), "waves": 3, "locked_candidates": True},
     {"max_depth": (48, 81), "waves": 3, "locked_candidates": True},
 ]

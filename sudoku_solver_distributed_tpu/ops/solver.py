@@ -70,7 +70,11 @@ def _mrv_cell(grid: jnp.ndarray, cand: jnp.ndarray):
 
 
 def _step(
-    state: _State, spec: BoardSpec, locked: bool = False, waves: int = 1
+    state: _State,
+    spec: BoardSpec,
+    locked: bool = False,
+    waves: int = 1,
+    light_waves: bool = False,
 ) -> _State:
     B, C = state.grid.shape
     D = state.stack_mask.shape[1]
@@ -158,7 +162,9 @@ def _step(
     # waves=2: 445 -> 291 iterations, ~+15% throughput). Boards that
     # contradicted, solved, or have no singles pass through untouched.
     for _ in range(waves - 1):
-        aw = analyze(grid.reshape(B, N, N), spec, locked=locked)
+        aw = analyze(
+            grid.reshape(B, N, N), spec, locked=locked and not light_waves
+        )
         assign_w = aw.assign.reshape(B, C)
         still_running = (new_status == RUNNING)
         w = (
@@ -212,10 +218,14 @@ def init_state(
 
 
 def step(
-    state: _State, spec: BoardSpec, locked: bool = False, waves: int = 1
+    state: _State,
+    spec: BoardSpec,
+    locked: bool = False,
+    waves: int = 1,
+    light_waves: bool = False,
 ) -> _State:
     """One lockstep solver iteration over the batch (public; see init_state)."""
-    return _step(state, spec, locked, waves)
+    return _step(state, spec, locked, waves, light_waves)
 
 
 def finalize_status(state: _State, spec: BoardSpec) -> _State:
@@ -272,6 +282,7 @@ def _run_widened(
     max_iters: int,
     locked: bool = False,
     waves: int = 1,
+    light_waves: bool = False,
 ) -> _State:
     """Race the pathological tail: restart each still-RUNNING board from its
     search root and explore all top-level candidates of its MRV cell as
@@ -339,7 +350,7 @@ def _run_widened(
         return (~parents_done(ws)).any() & (ws.iters < max_iters)
 
     w = jax.lax.while_loop(
-        cond, lambda ws: _step(ws, spec, locked, waves), w
+        cond, lambda ws: _step(ws, spec, locked, waves, light_waves), w
     )
     w = finalize_status(w, spec)
 
@@ -393,6 +404,7 @@ def _run_compacted(
     widen_after: int | None = None,
     locked: bool = False,
     waves: int = 1,
+    light_waves: bool = False,
 ) -> _State:
     """Run the lockstep loop with hierarchical active-board compaction.
 
@@ -418,7 +430,8 @@ def _run_compacted(
 
         if widen_after is None:
             return jax.lax.while_loop(
-                cond, lambda s: _step(s, spec, locked, waves), state
+                cond, lambda s: _step(s, spec, locked, waves, light_waves),
+                state,
             )
 
         grace_end = jnp.minimum(state.iters + widen_after, max_iters)
@@ -427,11 +440,15 @@ def _run_compacted(
             return running_of(s).any() & (s.iters < grace_end)
 
         state = jax.lax.while_loop(
-            grace_cond, lambda s: _step(s, spec, locked, waves), state
+            grace_cond,
+            lambda s: _step(s, spec, locked, waves, light_waves),
+            state,
         )
         return jax.lax.cond(
             running_of(state).any(),
-            lambda s: _run_widened(s, spec, max_iters, locked, waves),
+            lambda s: _run_widened(
+                s, spec, max_iters, locked, waves, light_waves
+            ),
             lambda s: s,
             state,
         )
@@ -443,7 +460,7 @@ def _run_compacted(
         return (s.iters < max_iters) & (running_of(s).sum() > next_cap)
 
     state = jax.lax.while_loop(
-        cond, lambda s: _step(s, spec, locked, waves), state
+        cond, lambda s: _step(s, spec, locked, waves, light_waves), state
     )
 
     # Stable sort: RUNNING boards (key 0) to the front, finished (key 1) after.
@@ -454,7 +471,8 @@ def _run_compacted(
         lambda x: x[:next_cap] if x.ndim else x, permuted
     )
     sub = _run_compacted(
-        sub, caps[1:], spec, max_iters, widen_after, locked, waves
+        sub, caps[1:], spec, max_iters, widen_after, locked, waves,
+        light_waves,
     )
     merged = _write_boards(permuted, sub, next_cap)
     return _take_boards(merged, inv)
@@ -509,6 +527,7 @@ def _retry_overflow(
     widen_after: int | None,
     locked: bool = False,
     waves: int = 1,
+    light_waves: bool = False,
 ) -> SolveResult:
     """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
 
@@ -530,6 +549,7 @@ def _retry_overflow(
             g2, spec, max_iters=max_iters, max_depth=depth,
             compact=compact, widen_after=widen_after,
             locked_candidates=locked, waves=waves,
+            light_waves=light_waves,
         )
         return merge_retry_result(need, res, r2)
 
@@ -546,6 +566,7 @@ def solve_batch(
     widen_after: int | None = None,
     locked_candidates: bool = False,
     waves: int = 1,
+    light_waves: bool = False,
 ) -> SolveResult:
     """Solve a batch of boards to completion (or proven unsatisfiability).
 
@@ -595,6 +616,13 @@ def solve_batch(
         2026-07-30 on the hard-9×9 corpus with locked sets: 445→291
         iterations, ~+15% throughput. ``iters`` counts fused iterations;
         ``validations`` still counts actual analysis sweeps.
+      light_waves: run the extra waves with singles-only analysis (no
+        locked-set eliminations) — each wave drops the locked/pair
+        elimination tensors while the base sweep keeps the full pruning
+        power. Iteration cost on the hard-9×9 corpus (CPU-measured;
+        iteration counts are platform-independent): 238 → 244 at
+        ``waves=3`` — whether the much cheaper sweeps win wall-clock is
+        a per-hardware trade (benchmarks/exp_sweep.py).
 
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """
@@ -604,11 +632,12 @@ def solve_batch(
             grid, spec, max_iters=max_iters, max_depth=depths[0],
             compact=compact, widen_after=widen_after,
             locked_candidates=locked_candidates, waves=waves,
+            light_waves=light_waves,
         )
         for d in depths[1:]:
             res = _retry_overflow(
                 grid, res, spec, d, max_iters, compact, widen_after,
-                locked_candidates, waves,
+                locked_candidates, waves, light_waves,
             )
         return res
 
@@ -619,7 +648,8 @@ def solve_batch(
     if widen_after is not None and caps[-1] * spec.size > 8192:
         widen_after = None  # see docstring: bound the widened batch's memory
     state = _run_compacted(
-        state, caps, spec, max_iters, widen_after, locked_candidates, waves
+        state, caps, spec, max_iters, widen_after, locked_candidates, waves,
+        light_waves,
     )
     state = finalize_status(state, spec)
 

@@ -282,3 +282,28 @@ def test_fused_propagation_waves_equivalent():
     res = solve_batch(jnp.asarray(batch), SPEC_9, waves=2)
     st = np.asarray(res.status)
     assert st[0] == UNSAT and st[1] == UNSAT and st[2] == SOLVED
+
+
+def test_light_waves_same_solutions():
+    """Singles-only extra waves change only the iteration schedule: same
+    solutions, same verdicts as full-analysis waves (unique corpus, so the
+    grids must be identical)."""
+    import jax.numpy as jnp
+
+    boards = generate_batch(16, 55, seed=91, unique=True)
+    full = solve_batch(
+        jnp.asarray(boards), SPEC_9,
+        locked_candidates=True, waves=3, light_waves=False,
+    )
+    light = solve_batch(
+        jnp.asarray(boards), SPEC_9,
+        locked_candidates=True, waves=3, light_waves=True,
+    )
+    assert bool(np.asarray(light.solved).all())
+    np.testing.assert_array_equal(
+        np.asarray(light.grid), np.asarray(full.grid)
+    )
+    # without locked analysis light waves are plain waves: identical graphs
+    a = solve_batch(jnp.asarray(boards), SPEC_9, waves=2, light_waves=True)
+    b = solve_batch(jnp.asarray(boards), SPEC_9, waves=2)
+    assert int(a.iters) == int(b.iters)
