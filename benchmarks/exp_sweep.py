@@ -36,12 +36,15 @@ SIZE = int(os.environ.get("BENCH_SIZE", "9"))
 _DEFAULT_BATCH = {9: 16384, 16: 2048, 25: 128}
 REPEATS = int(os.environ.get("BENCH_REPEATS", "5"))
 
+# CPU-measured iteration counts (hard-9×9 corpus, platform-independent):
+# full-analysis waves=3 → 238; light waves=3/4/5/6 → 244/220/208/206.
+# The TPU question is wall-clock per iteration for each.
 DEFAULTS = [
     {"max_depth": (32, 81), "waves": 3, "locked_candidates": True},
     {"max_depth": (32, 81), "waves": 3, "light_waves": True},
     {"max_depth": (32, 81), "waves": 4, "light_waves": True},
-    {"max_depth": (24, 81), "waves": 3, "locked_candidates": True},
-    {"max_depth": (48, 81), "waves": 3, "locked_candidates": True},
+    {"max_depth": (32, 81), "waves": 5, "light_waves": True},
+    {"max_depth": (24, 81), "waves": 4, "light_waves": True},
 ]
 
 
