@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ops import BoardSpec, SPEC_9, solve_batch
+from .ops.solver import RUNNING
 from .utils.profiling import annotate, device_trace
 
 
@@ -71,6 +72,8 @@ class SolverEngine:
         backend: str = "xla",
         locked_candidates: Optional[bool] = None,
         waves: Optional[int] = None,
+        max_iters: int = 4096,
+        deep_retry_factor: int = 16,
     ):
         if backend not in ("xla", "pallas"):
             raise ValueError(f"unknown engine backend {backend!r}")
@@ -108,6 +111,18 @@ class SolverEngine:
                 "waves is not supported by the pallas kernel"
             )
         self.waves = waves
+        # Iteration budget per device call, and the RUNNING safety net: a
+        # board still RUNNING at the cap (possible only for adversarial
+        # inputs — the whole 2000-board fuzz corpus finishes within 4096
+        # under the serving config, tests/test_fuzz_solver.py) is re-solved
+        # once at ``deep_retry_factor ×`` the budget rather than misreported
+        # as "no solution" (the reference would grind forever instead,
+        # reference node.py:427-475). A board capped even by the retry is
+        # surfaced as ``info["capped"]`` by solve_batch_np. Both values are
+        # baked into the compiled closures below — constructor-only, frozen
+        # after init (unlike waves/locked_candidates they are never re-read).
+        self.max_iters = max_iters
+        self.deep_retry_factor = deep_retry_factor
         # Multi-host frontier serving: when set (a callable board ->
         # (solution | None, info)), single-board solves delegate to it
         # instead of calling frontier_solve locally — the CLI points this
@@ -127,7 +142,7 @@ class SolverEngine:
         self.validations = 0
         self.solved_puzzles = 0
 
-        def _run(grid):
+        def _run(grid, mi=max_iters):
             B = grid.shape[0]
             # Fused waves amortize the step's merge/stack machinery over a
             # batch; a single board has nothing to amortize — extra sweeps
@@ -147,6 +162,7 @@ class SolverEngine:
                     self.spec,
                     block=128,
                     max_depth=self.max_depth,
+                    max_iters=mi,
                     interpret=jax.default_backend() != "tpu",
                 )
             else:
@@ -154,6 +170,7 @@ class SolverEngine:
                     grid,
                     self.spec,
                     max_depth=self.max_depth,
+                    max_iters=mi,
                     locked_candidates=self.locked_candidates,
                     waves=waves_eff,
                 )
@@ -176,6 +193,11 @@ class SolverEngine:
         # buffer (different trailing shape), so donation would be a no-op
         # that only emits "donated buffers were not usable" warnings
         self._solve = jax.jit(_run)
+        # the RUNNING safety net (see max_iters above); compiles only if an
+        # adversarial board ever hits the cap
+        self._solve_deep = jax.jit(
+            lambda grid: _run(grid, max_iters * deep_retry_factor)
+        )
 
     @property
     def frontier_enabled(self) -> bool:
@@ -219,7 +241,23 @@ class SolverEngine:
                 self._profile_mutex.release()
         else:
             packed = self._solve(self._device_batch(boards))
-        return np.asarray(packed)[:n]
+        packed = np.array(packed)
+        C = self.spec.cells
+        running = packed[:, C + 1] == RUNNING
+        # trigger on REAL rows only: under a tiny cap the empty pad boards
+        # can themselves hit it, and a deep pass for discarded lanes is
+        # pure waste (the merge below may still overwrite pad rows — they
+        # are sliced off either way)
+        if running[:n].any():
+            # iteration-capped lanes (adversarial inputs only): one deep
+            # retry instead of misreporting "no solution"; work counters
+            # accumulate across attempts like the staged-depth retry
+            deep = np.asarray(self._solve_deep(self._device_batch(boards)))
+            first = packed
+            packed = np.where(running[:, None], deep, packed)
+            packed[running, C + 2] += first[running, C + 2]
+            packed[running, C + 3] += first[running, C + 3]
+        return packed[:n]
 
     # -- public API --------------------------------------------------------
     def warmup(self) -> None:
@@ -263,7 +301,9 @@ class SolverEngine:
 
         Returns (solutions, solved_mask, info). Solutions rows for unsolved
         boards hold the partial/original grid. Tiles over the largest bucket
-        for oversize batches.
+        for oversize batches. ``info["capped"]`` counts boards whose search
+        exhausted even the deep-retry iteration budget — for those "not
+        solved" means "not finished", not "proven unsatisfiable".
         """
         boards = np.asarray(boards, np.int32)
         B = boards.shape[0]
@@ -278,12 +318,14 @@ class SolverEngine:
         solved_mask = packed[:, C].astype(bool)
         validations = int(packed[:, C + 3].sum())
         guesses = int(packed[:, C + 2].sum())
+        capped = int((packed[:, C + 1] == RUNNING).sum())
         with self._lock:
             self.validations += validations
             self.solved_puzzles += int(solved_mask.sum())
         return solutions, solved_mask, {
             "validations": validations,
             "guesses": guesses,
+            "capped": capped,
         }
 
     def _frontier_raw(self, arr: np.ndarray):

@@ -619,10 +619,17 @@ def solve_batch(
       light_waves: run the extra waves with singles-only analysis (no
         locked-set eliminations) — each wave drops the locked/pair
         elimination tensors while the base sweep keeps the full pruning
-        power. Iteration cost on the hard-9×9 corpus (CPU-measured;
-        iteration counts are platform-independent): 238 → 244 at
-        ``waves=3`` — whether the much cheaper sweeps win wall-clock is
-        a per-hardware trade (benchmarks/exp_sweep.py).
+        power. Iteration cost on the hard-9×9 (solvable) corpus
+        (CPU-measured; iteration counts are platform-independent):
+        238 → 244 at ``waves=3``. CAUTION — unsuitable where
+        unsatisfiable inputs matter: a light wave can fill a cell whose
+        *locked* candidate set is empty (the wide set has exactly one
+        bit), painting over the contradiction; refutation then needs
+        deep search instead of one sweep. Fuzz-measured worst case
+        (tests/test_fuzz_solver.py): 66 → 11,262 iterations to prove one
+        corrupted 15-clue board UNSAT. Verdicts stay correct — only the
+        iteration bill changes — so this is an opt-in for known-solvable
+        batch workloads, never the serving default.
 
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """

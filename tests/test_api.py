@@ -118,3 +118,47 @@ def test_engine_batch_buckets():
     boards = generate_batch(10, 30, seed=6)
     sols, mask, info = eng.solve_batch_np(boards)
     assert mask.all() and sols.shape == (10, 9, 9)
+
+
+def test_engine_deep_retry_rescues_iteration_capped_boards():
+    """A board still RUNNING at the engine's iteration cap is re-solved once
+    at deep_retry_factor x the budget instead of being misreported as
+    unsolvable (the safety net for adversarial inputs; the bench corpora
+    never hit it)."""
+    from conftest import README_PUZZLE
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+
+    board = np.asarray(README_PUZZLE, np.int32)
+    # cap 2: the first pass cannot finish the 8-clue README board
+    eng = SolverEngine(buckets=(1,), max_iters=2, deep_retry_factor=2048)
+    lo = SolverEngine(buckets=(1,), max_iters=2, deep_retry_factor=2)
+    sols, ok, info = eng.solve_batch_np(board[None])
+    assert bool(ok.all())
+    assert oracle_is_valid_solution(sols[0].tolist())
+    # the failed first attempt's sweeps are still billed
+    assert info["validations"] >= 2
+    # a deep retry that ALSO caps out still reports honestly: not solved
+    sols2, ok2, _ = lo.solve_batch_np(board[None])
+    assert not bool(ok2.any())
+    assert (sols2[0][board > 0] == board[board > 0]).all()
+
+
+def test_engine_reports_capped_not_unsat():
+    """When even the deep retry hits its budget, info['capped'] separates
+    'not finished' from 'proven unsatisfiable'."""
+    from conftest import README_PUZZLE
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+
+    lo = SolverEngine(buckets=(1,), max_iters=2, deep_retry_factor=2)
+    sols, ok, info = lo.solve_batch_np(np.asarray(README_PUZZLE)[None])
+    assert not bool(ok.any())
+    assert info["capped"] == 1
+    # a genuinely unsatisfiable board is NOT capped: verdict is real
+    bad = np.zeros((9, 9), np.int32)
+    bad[0, 0] = bad[0, 1] = 5
+    _, ok2, info2 = lo.solve_batch_np(bad[None])
+    assert not bool(ok2.any())
+    assert info2["capped"] == 0
