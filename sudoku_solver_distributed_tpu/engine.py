@@ -13,6 +13,7 @@ donated-buffer device call on a hot program.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -23,6 +24,8 @@ import numpy as np
 from .ops import BoardSpec, SPEC_9, solve_batch
 from .ops.solver import RUNNING
 from .utils.profiling import annotate, device_trace
+
+logger = logging.getLogger(__name__)
 
 
 DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
@@ -426,5 +429,13 @@ class SolverEngine:
             return self._frontier_solve(arr)
         solutions, solved_mask, info = self.solve_batch_np(arr[None])
         if not solved_mask[0]:
+            if info.get("capped"):
+                # the HTTP surface must answer the reference's exact
+                # "No solution found" body either way (http_api.py), so
+                # the not-finished-vs-proven-UNSAT distinction lives here
+                logger.warning(
+                    "solve_one: iteration budget exhausted (deep retry "
+                    "included) — board not finished, NOT proven unsolvable"
+                )
             return None, info
         return solutions[0].tolist(), info
