@@ -86,3 +86,40 @@ def test_fuzz_configs_vs_oracle():
                 assert (grids[k][mask] == boards[k][mask]).all(), (cfg, k)
             else:
                 assert status[k] == UNSAT, (cfg, k, status[k])
+
+
+def test_fuzz_16x16_vs_oracle():
+    """Hexadoku through the same harness: hole-punched and corrupted
+    boards, verdicts pinned to the oracle (native-backed count)."""
+    from sudoku_solver_distributed_tpu.ops import spec_for_size
+
+    n = int(os.environ.get("FUZZ_BOARDS_16", "12"))
+    rng = random.Random(SEED + 16)
+    base = generate_batch(n, 1, size=16, seed=rng.randrange(1 << 30))
+    boards = []
+    for k in range(n):
+        g = np.asarray(base[k]).reshape(-1)
+        idx = rng.sample(range(256), rng.randrange(40, 150))
+        g[idx] = 0
+        g = g.reshape(16, 16)
+        if rng.random() < 0.3:
+            clues = np.argwhere(g > 0)
+            i, j = clues[rng.randrange(len(clues))]
+            g[i, j] = rng.randrange(1, 17)
+        boards.append(g)
+    boards = np.stack(boards)
+    solvable = [count_solutions(b.tolist(), limit=1) > 0 for b in boards]
+    res = solve_batch(
+        jnp.asarray(boards), spec_for_size(16),
+        max_iters=65536, locked_candidates=True, waves=3,
+    )
+    status = np.asarray(res.status)
+    grids = np.asarray(res.grid)
+    for k in range(n):
+        if solvable[k]:
+            assert status[k] == SOLVED, (k, status[k])
+            assert oracle_is_valid_solution(grids[k].tolist()), k
+            mask = boards[k] > 0
+            assert (grids[k][mask] == boards[k][mask]).all(), k
+        else:
+            assert status[k] == UNSAT, (k, status[k])
