@@ -80,6 +80,8 @@ def _load_corpus():
 
 
 def main():
+    import threading
+
     import jax
 
     # BENCH_PLATFORM reroutes throughput runs (e.g. =cpu for smoke tests);
@@ -89,6 +91,26 @@ def main():
     platform = os.environ.get("BENCH_PLATFORM")
     if platform:
         jax.config.update("jax_platforms", platform)
+
+    # Watchdog: on a pooled/tunneled accelerator a stale pool-side claim
+    # makes backend init hang indefinitely (docs/OPERATIONS.md). Fail fast
+    # with a diagnosable message instead of wedging the caller's pipeline.
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "900"))
+    init_done = threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(init_timeout):
+            print(
+                f"# FATAL: accelerator backend init exceeded "
+                f"{init_timeout:.0f}s — pooled-chip claim unavailable "
+                f"(stale claim? see docs/OPERATIONS.md); rerun when the "
+                f"claim frees or set BENCH_PLATFORM=cpu",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
 
     import jax.numpy as jnp
     import numpy as np
@@ -100,6 +122,7 @@ def main():
     clues = int((boards[0] > 0).sum())
 
     n_chips = max(1, len(jax.devices()))
+    init_done.set()  # backend is up; disarm the claim watchdog
     # staged depth: shallow fast path + full-depth overflow retry behind a
     # lax.cond (ops/solver.py) — the guess stack dominates state traffic, so
     # a shallow first stage is faster and the retry keeps it safe (measured
