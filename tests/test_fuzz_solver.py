@@ -123,3 +123,39 @@ def test_fuzz_16x16_vs_oracle():
             assert (grids[k][mask] == boards[k][mask]).all(), k
         else:
             assert status[k] == UNSAT, (k, status[k])
+
+
+def test_fuzz_25x25_vs_oracle():
+    """25×25 through the same harness (the largest BoardSpec)."""
+    from sudoku_solver_distributed_tpu.ops import spec_for_size
+
+    n = int(os.environ.get("FUZZ_BOARDS_25", "4"))
+    rng = random.Random(SEED + 25)
+    base = generate_batch(n, 1, size=25, seed=rng.randrange(1 << 30))
+    boards = []
+    for k in range(n):
+        g = np.asarray(base[k]).reshape(-1)
+        idx = rng.sample(range(625), rng.randrange(100, 320))
+        g[idx] = 0
+        g = g.reshape(25, 25)
+        if rng.random() < 0.3:
+            clues = np.argwhere(g > 0)
+            i, j = clues[rng.randrange(len(clues))]
+            g[i, j] = rng.randrange(1, 26)
+        boards.append(g)
+    boards = np.stack(boards)
+    solvable = [count_solutions(b.tolist(), limit=1) > 0 for b in boards]
+    res = solve_batch(
+        jnp.asarray(boards), spec_for_size(25),
+        max_iters=65536, locked_candidates=True, waves=3,
+    )
+    status = np.asarray(res.status)
+    grids = np.asarray(res.grid)
+    for k in range(n):
+        if solvable[k]:
+            assert status[k] == SOLVED, (k, status[k])
+            assert oracle_is_valid_solution(grids[k].tolist()), k
+            mask = boards[k] > 0
+            assert (grids[k][mask] == boards[k][mask]).all(), k
+        else:
+            assert status[k] == UNSAT, (k, status[k])
