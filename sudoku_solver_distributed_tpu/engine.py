@@ -394,6 +394,7 @@ class SolverEngine:
             keep_checkpoint=keep_checkpoint,
             sharding=self.sharding,
             locked=self.locked_candidates,
+            waves=self.waves,
         )
         solved_mask = np.asarray(res.solved)
         validations = int(np.asarray(res.validations).sum())

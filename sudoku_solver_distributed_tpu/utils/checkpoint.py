@@ -112,13 +112,17 @@ def load_solver_state(
     )
 
 
-@partial(jax.jit, static_argnames=("spec", "chunk", "max_iters", "locked"))
+@partial(
+    jax.jit,
+    static_argnames=("spec", "chunk", "max_iters", "locked", "waves"),
+)
 def _run_chunk(
     state: S._State,
     spec: BoardSpec,
     chunk: int,
     max_iters: int,
     locked: bool = False,
+    waves: int = 1,
 ):
     """Advance every RUNNING board by ≤``chunk`` lockstep iterations."""
     target = jax.numpy.minimum(state.iters + chunk, max_iters)
@@ -126,7 +130,9 @@ def _run_chunk(
     def cond(s):
         return ((s.status == S.RUNNING).any()) & (s.iters < target)
 
-    return jax.lax.while_loop(cond, lambda s: S.step(s, spec, locked), state)
+    return jax.lax.while_loop(
+        cond, lambda s: S.step(s, spec, locked, waves), state
+    )
 
 
 def solve_batch_resumable(
@@ -140,6 +146,7 @@ def solve_batch_resumable(
     keep_checkpoint: bool = False,
     sharding=None,
     locked: bool = False,
+    waves: int = 1,
 ) -> S.SolveResult:
     """Solve a batch with periodic checkpoints; resume if one exists.
 
@@ -194,7 +201,7 @@ def solve_batch_resumable(
 
     while True:
         state = jax.block_until_ready(
-            _run_chunk(state, spec, chunk_iters, max_iters, locked)
+            _run_chunk(state, spec, chunk_iters, max_iters, locked, waves)
         )
         done = not bool(np.asarray(state.status == S.RUNNING).any())
         if done:
