@@ -11,7 +11,7 @@ emit multi-solution puzzles, which makes golden testing flaky).
 from __future__ import annotations
 
 import random
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 

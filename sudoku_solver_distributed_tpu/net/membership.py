@@ -26,7 +26,6 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Set
 
-from .wire import Msg
 
 
 class Membership:

@@ -15,8 +15,7 @@ from functools import partial
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import BoardSpec, SPEC_9, solve_batch
 
