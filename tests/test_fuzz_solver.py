@@ -126,7 +126,12 @@ def test_fuzz_16x16_vs_oracle():
 
 
 def test_fuzz_25x25_vs_oracle():
-    """25×25 through the same harness (the largest BoardSpec)."""
+    """25×25 through the same harness (the largest BoardSpec).
+
+    Scale FUZZ_BOARDS_25 with care: a corrupted near-minimal 25×25 board
+    can be refutation-hard for the oracle AND the kernel alike (a 16-board
+    campaign was observed to burn >30 CPU-minutes on one such board); the
+    default size keeps the draw inside the fast regime."""
     from sudoku_solver_distributed_tpu.ops import spec_for_size
 
     n = int(os.environ.get("FUZZ_BOARDS_25", "4"))
