@@ -207,8 +207,24 @@ def warm_seeding(spec: BoardSpec, target: int, locked: bool = False) -> None:
             m *= 2
 
 
-@lru_cache(maxsize=None)
 def _make_racer(
+    mesh,
+    spec: BoardSpec,
+    max_iters: int,
+    max_depth,
+    locked: bool = False,
+    waves: int = 1,
+):
+    """Compile the shard_map race (cached). A staged (tuple) ``max_depth``
+    collapses to its deepest stage here — the single choke point, so engine
+    warmup and serving land on the same cache entry."""
+    if isinstance(max_depth, (tuple, list)):
+        max_depth = max(max_depth)
+    return _make_racer_cached(mesh, spec, max_iters, max_depth, locked, waves)
+
+
+@lru_cache(maxsize=None)
+def _make_racer_cached(
     mesh,
     spec: BoardSpec,
     max_iters: int,
@@ -294,7 +310,14 @@ def frontier_solve(
 
     Returns (solution | None, info). info carries 'validations' (total sweep
     count over all chips) and 'seeded' (number of speculative states).
+
+    A staged (tuple) ``max_depth`` — the batch engine's shape — collapses
+    to its deepest stage: the race runs one flat loop per subtree, so only
+    the full-depth guarantee is meaningful here (and it must be hashable
+    for the racer cache).
     """
+    if isinstance(max_depth, (tuple, list)):
+        max_depth = max(max_depth)
     mesh = mesh if mesh is not None else default_mesh()
     n_dev = mesh.devices.size
     target = n_dev * states_per_device

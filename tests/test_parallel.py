@@ -96,3 +96,17 @@ def test_frontier_solve_hard_16x16():
     sol, _ = frontier_solve(board, spec=spec16, states_per_device=8)
     assert sol is not None
     assert oracle_is_valid_solution(sol)
+
+
+def test_frontier_accepts_staged_depth_tuple(readme_puzzle):
+    """An engine configured with the batch path's staged (tuple) max_depth
+    must not crash the frontier race: the tuple collapses to its deepest
+    stage at the racer choke point."""
+    import jax
+
+    mesh = default_mesh(jax.devices()[:4])
+    sol, info = frontier_solve(
+        readme_puzzle, mesh, states_per_device=4, max_depth=(32, 81)
+    )
+    assert sol is not None
+    assert info["validations"] > 0
