@@ -162,6 +162,11 @@ def solve_batch_resumable(
     grid = np.asarray(grid, np.int32)
     if spec is None:
         spec = spec_for_size(grid.shape[-1])
+    if isinstance(max_depth, (tuple, list)):
+        # staged depth is a batch-engine shape; the chunked loop is flat,
+        # so only the deepest stage's guarantee applies (same collapse as
+        # parallel/frontier.py)
+        max_depth = max(max_depth)
     fingerprint = boards_fingerprint(grid)
 
     if os.path.exists(checkpoint_path):

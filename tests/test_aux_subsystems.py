@@ -255,3 +255,20 @@ def test_resumable_keeps_snapshot_on_budget_exhaustion(tmp_path):
     assert bool(np.asarray(res2.solved).all())
     assert int(res2.iters) >= 8  # continued, not restarted
     assert not os.path.exists(ck)
+
+
+def test_resumable_accepts_staged_depth_tuple(tmp_path):
+    """An engine configured with staged (tuple) max_depth must not crash the
+    resumable path — the tuple collapses to its deepest stage, like the
+    frontier racer."""
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    eng = SolverEngine(buckets=(4,), max_depth=(16, 81))
+    boards = generate_batch(4, 45, seed=55, unique=True)
+    sols, ok, info = eng.solve_batch_resumable_np(
+        np.asarray(boards), str(tmp_path / "snap.npz")
+    )
+    assert bool(ok.all())
