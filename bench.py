@@ -112,6 +112,12 @@ def main():
 
     threading.Thread(target=_watchdog, daemon=True).start()
 
+    # touch the backend FIRST so the watchdog window covers exactly the
+    # claim acquisition — corpus generation below is host-side work that
+    # can legitimately take long on a first uncached run
+    n_chips = max(1, len(jax.devices()))
+    init_done.set()  # backend is up; disarm the claim watchdog
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -120,9 +126,6 @@ def main():
     spec = spec_for_size(BENCH_SIZE)
     boards = _load_corpus()
     clues = int((boards[0] > 0).sum())
-
-    n_chips = max(1, len(jax.devices()))
-    init_done.set()  # backend is up; disarm the claim watchdog
     # staged depth: shallow fast path + full-depth overflow retry behind a
     # lax.cond (ops/solver.py) — the guess stack dominates state traffic, so
     # a shallow first stage is faster and the retry keeps it safe (measured

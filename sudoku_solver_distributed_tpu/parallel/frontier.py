@@ -311,13 +311,11 @@ def frontier_solve(
     Returns (solution | None, info). info carries 'validations' (total sweep
     count over all chips) and 'seeded' (number of speculative states).
 
-    A staged (tuple) ``max_depth`` — the batch engine's shape — collapses
-    to its deepest stage: the race runs one flat loop per subtree, so only
-    the full-depth guarantee is meaningful here (and it must be hashable
-    for the racer cache).
+    A staged (tuple) ``max_depth`` — the batch engine's shape — is accepted
+    and collapses to its deepest stage inside ``_make_racer`` (the race
+    runs one flat loop per subtree, so only the full-depth guarantee is
+    meaningful).
     """
-    if isinstance(max_depth, (tuple, list)):
-        max_depth = max(max_depth)
     mesh = mesh if mesh is not None else default_mesh()
     n_dev = mesh.devices.size
     target = n_dev * states_per_device
