@@ -164,3 +164,23 @@ def test_fuzz_25x25_vs_oracle():
             assert (grids[k][mask] == boards[k][mask]).all(), k
         else:
             assert status[k] == UNSAT, (k, status[k])
+
+
+def test_fuzz_engine_serving_path_vs_oracle():
+    """The serving wrapper (bucket tiling, result packing, deep retry) over
+    the same randomized corpus: what POST /solve actually runs."""
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+
+    rng = random.Random(SEED + 1)
+    boards = _fuzz_corpus(int(os.environ.get("FUZZ_BOARDS_ENGINE", "48")), rng)
+    solvable = [count_solutions(b.tolist(), limit=1) > 0 for b in boards]
+    eng = SolverEngine(buckets=(16,))  # force tiling across several buckets
+    sols, ok, info = eng.solve_batch_np(boards)
+    assert info["capped"] == 0  # the serving config finishes this corpus
+    for k in range(len(boards)):
+        assert bool(ok[k]) == solvable[k], (k, ok[k], solvable[k])
+        if solvable[k]:
+            assert oracle_is_valid_solution(sols[k].tolist()), k
+            mask = boards[k] > 0
+            assert (sols[k][mask] == boards[k][mask]).all(), k
+    assert eng.solved_puzzles == sum(solvable)
