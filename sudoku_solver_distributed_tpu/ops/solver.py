@@ -75,6 +75,7 @@ def _step(
     locked: bool = False,
     waves: int = 1,
     light_waves: bool = False,
+    naked_pairs: bool | None = None,
 ) -> _State:
     B, C = state.grid.shape
     D = state.stack_mask.shape[1]
@@ -83,7 +84,10 @@ def _step(
 
     # One fused sweep analysis shared with the standalone propagator
     # (ops/propagate.py): candidates, forced singles, contradiction, solved.
-    a = analyze(state.grid.reshape(B, N, N), spec, locked=locked)
+    a = analyze(
+        state.grid.reshape(B, N, N), spec, locked=locked,
+        naked_pairs=naked_pairs,
+    )
     cand = a.cand.reshape(B, C)
     assign = a.assign.reshape(B, C)
     contra, solved = a.contradiction, a.solved
@@ -163,7 +167,8 @@ def _step(
     # contradicted, solved, or have no singles pass through untouched.
     for _ in range(waves - 1):
         aw = analyze(
-            grid.reshape(B, N, N), spec, locked=locked and not light_waves
+            grid.reshape(B, N, N), spec, locked=locked and not light_waves,
+            naked_pairs=naked_pairs,
         )
         assign_w = aw.assign.reshape(B, C)
         still_running = (new_status == RUNNING)
@@ -223,9 +228,10 @@ def step(
     locked: bool = False,
     waves: int = 1,
     light_waves: bool = False,
+    naked_pairs: bool | None = None,
 ) -> _State:
     """One lockstep solver iteration over the batch (public; see init_state)."""
-    return _step(state, spec, locked, waves, light_waves)
+    return _step(state, spec, locked, waves, light_waves, naked_pairs)
 
 
 def finalize_status(state: _State, spec: BoardSpec) -> _State:
@@ -283,6 +289,7 @@ def _run_widened(
     locked: bool = False,
     waves: int = 1,
     light_waves: bool = False,
+    naked_pairs: bool | None = None,
 ) -> _State:
     """Race the pathological tail: restart each still-RUNNING board from its
     search root and explore all top-level candidates of its MRV cell as
@@ -318,7 +325,9 @@ def _run_widened(
         state.grid,
     )
 
-    a = analyze(root.reshape(R, N, N), spec, locked=locked)
+    a = analyze(
+        root.reshape(R, N, N), spec, locked=locked, naked_pairs=naked_pairs
+    )
     cand = a.cand.reshape(R, C)
     cell, cmask = _mrv_cell(root, cand)                       # (R,), (R,)
 
@@ -350,7 +359,9 @@ def _run_widened(
         return (~parents_done(ws)).any() & (ws.iters < max_iters)
 
     w = jax.lax.while_loop(
-        cond, lambda ws: _step(ws, spec, locked, waves, light_waves), w
+        cond,
+        lambda ws: _step(ws, spec, locked, waves, light_waves, naked_pairs),
+        w,
     )
     w = finalize_status(w, spec)
 
@@ -405,6 +416,7 @@ def _run_compacted(
     locked: bool = False,
     waves: int = 1,
     light_waves: bool = False,
+    naked_pairs: bool | None = None,
 ) -> _State:
     """Run the lockstep loop with hierarchical active-board compaction.
 
@@ -430,7 +442,10 @@ def _run_compacted(
 
         if widen_after is None:
             return jax.lax.while_loop(
-                cond, lambda s: _step(s, spec, locked, waves, light_waves),
+                cond,
+                lambda s: _step(
+                    s, spec, locked, waves, light_waves, naked_pairs
+                ),
                 state,
             )
 
@@ -441,13 +456,15 @@ def _run_compacted(
 
         state = jax.lax.while_loop(
             grace_cond,
-            lambda s: _step(s, spec, locked, waves, light_waves),
+            lambda s: _step(
+                s, spec, locked, waves, light_waves, naked_pairs
+            ),
             state,
         )
         return jax.lax.cond(
             running_of(state).any(),
             lambda s: _run_widened(
-                s, spec, max_iters, locked, waves, light_waves
+                s, spec, max_iters, locked, waves, light_waves, naked_pairs
             ),
             lambda s: s,
             state,
@@ -460,7 +477,9 @@ def _run_compacted(
         return (s.iters < max_iters) & (running_of(s).sum() > next_cap)
 
     state = jax.lax.while_loop(
-        cond, lambda s: _step(s, spec, locked, waves, light_waves), state
+        cond,
+        lambda s: _step(s, spec, locked, waves, light_waves, naked_pairs),
+        state,
     )
 
     # Stable sort: RUNNING boards (key 0) to the front, finished (key 1) after.
@@ -472,7 +491,7 @@ def _run_compacted(
     )
     sub = _run_compacted(
         sub, caps[1:], spec, max_iters, widen_after, locked, waves,
-        light_waves,
+        light_waves, naked_pairs,
     )
     merged = _write_boards(permuted, sub, next_cap)
     return _take_boards(merged, inv)
@@ -528,6 +547,7 @@ def _retry_overflow(
     locked: bool = False,
     waves: int = 1,
     light_waves: bool = False,
+    naked_pairs: bool | None = None,
 ) -> SolveResult:
     """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
 
@@ -549,7 +569,7 @@ def _retry_overflow(
             g2, spec, max_iters=max_iters, max_depth=depth,
             compact=compact, widen_after=widen_after,
             locked_candidates=locked, waves=waves,
-            light_waves=light_waves,
+            light_waves=light_waves, naked_pairs=naked_pairs,
         )
         return merge_retry_result(need, res, r2)
 
@@ -567,6 +587,7 @@ def solve_batch(
     locked_candidates: bool = False,
     waves: int = 1,
     light_waves: bool = False,
+    naked_pairs: bool | None = None,
 ) -> SolveResult:
     """Solve a batch of boards to completion (or proven unsatisfiability).
 
@@ -616,6 +637,13 @@ def solve_batch(
         2026-07-30 on the hard-9×9 corpus with locked sets: 445→291
         iterations, ~+15% throughput. ``iters`` counts fused iterations;
         ``validations`` still counts actual analysis sweeps.
+      naked_pairs: whether locked sweeps include naked-pair detection
+        (None = follow ``locked_candidates``). The pair equality tensor is
+        the sweep's most expensive term; on the three committed bench
+        corpora disabling it leaves the search bit-identical, though that
+        subsumption is corpus-dependent (see ops/propagate.analyze) — the
+        bench runs pairs-off; serving keeps them on until the TPU timing
+        confirms (ROADMAP).
       light_waves: run the extra waves with singles-only analysis (no
         locked-set eliminations) — each wave drops the locked/pair
         elimination tensors while the base sweep keeps the full pruning
@@ -639,12 +667,12 @@ def solve_batch(
             grid, spec, max_iters=max_iters, max_depth=depths[0],
             compact=compact, widen_after=widen_after,
             locked_candidates=locked_candidates, waves=waves,
-            light_waves=light_waves,
+            light_waves=light_waves, naked_pairs=naked_pairs,
         )
         for d in depths[1:]:
             res = _retry_overflow(
                 grid, res, spec, d, max_iters, compact, widen_after,
-                locked_candidates, waves, light_waves,
+                locked_candidates, waves, light_waves, naked_pairs,
             )
         return res
 
@@ -656,7 +684,7 @@ def solve_batch(
         widen_after = None  # see docstring: bound the widened batch's memory
     state = _run_compacted(
         state, caps, spec, max_iters, widen_after, locked_candidates, waves,
-        light_waves,
+        light_waves, naked_pairs,
     )
     state = finalize_status(state, spec)
 
