@@ -1,0 +1,147 @@
+"""Measure the bucket-path vs frontier-race crossover (VERDICT r3 task 3).
+
+Where does the mesh race (parallel/frontier.py) actually WIN? For each board
+in the adversarial deep-search corpus (benchmarks/make_adversarial.py) and a
+control slice of the ordinary hard corpus, measure per board:
+
+  * bucket  — blocking single-board solve on the serving bucket path
+              (bucket 1, waves_eff=1, full iteration budget);
+  * race    — ``frontier_solve`` on the default mesh (states_per_device
+              as served);
+  * iters   — the board's lockstep iteration count (platform-independent
+              difficulty, what the auto-route probe actually observes).
+
+Output: a per-decile table of (iters, bucket_ms, race_ms) + the measured
+crossover iteration count — the smallest iters bucket where the race's
+median beats the bucket path's. That number justifies (or corrects)
+``SolverEngine(frontier_escalate_iters=...)``.
+
+Platform note: on the virtual CPU mesh the 8 shards serialize on one core,
+so race_ms is pessimistic there; run on real hardware for the serving
+decision (benchmarks/tpu_session.py carries a phase for it). Iteration
+counts are platform-independent either way.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STATES = int(os.environ.get("XO_STATES", "64"))
+CONTROL = int(os.environ.get("XO_CONTROL", "32"))
+REPS = int(os.environ.get("XO_REPS", "3"))
+
+
+def main():
+    import jax
+
+    if os.environ.get("XO_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["XO_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.parallel import (
+        default_mesh,
+        frontier_solve,
+    )
+
+    adv_path = os.path.join(REPO, "benchmarks", "corpus_9x9_adversarial_128.npz")
+    adv = np.load(adv_path)
+    hard = np.load(
+        os.path.join(REPO, "benchmarks", "corpus_9x9_hard_4096.npz")
+    )["boards"][:CONTROL]
+    boards = np.concatenate([hard, adv["boards"]])
+
+    mesh = default_mesh()
+    eng = SolverEngine(buckets=(1,))  # plain bucket path, serving config
+    eng.warmup()
+
+    race_kw = dict(
+        states_per_device=STATES,
+        locked=eng.locked_candidates,
+        waves=eng.waves,
+        max_depth=eng.max_depth,
+        naked_pairs=eng.naked_pairs,
+    )
+    # warm the race on the first board
+    frontier_solve(boards[-1], mesh, **race_kw)
+
+    rows = []
+    for k, board in enumerate(boards):
+        bucket_ms = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            sol, info = eng.solve_one(board, frontier=False)
+            bucket_ms.append((time.perf_counter() - t0) * 1e3)
+        race_ms = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            rsol, rinfo = frontier_solve(board, mesh, **race_kw)
+            race_ms.append((time.perf_counter() - t0) * 1e3)
+        assert (sol is None) == (rsol is None), f"verdict mismatch board {k}"
+        rows.append(
+            {
+                "k": k,
+                "cls": "hard" if k < len(hard) else "adv",
+                "clues": int((board > 0).sum()),
+                "guesses": int(info["guesses"]),
+                "bucket_ms": round(min(bucket_ms), 2),
+                "race_ms": round(min(race_ms), 2),
+            }
+        )
+        if k % 16 == 0:
+            print(f"# {k + 1}/{len(boards)}", file=sys.stderr, flush=True)
+
+    # difficulty proxy: bucket-path guesses (monotone with search depth)
+    rows.sort(key=lambda r: r["guesses"])
+    wins = [r for r in rows if r["race_ms"] < r["bucket_ms"]]
+    crossover = None
+    # smallest difficulty from which the race wins the MAJORITY of boards
+    for i, r in enumerate(rows):
+        tail = rows[i:]
+        tail_wins = sum(t["race_ms"] < t["bucket_ms"] for t in tail)
+        if tail and tail_wins / len(tail) > 0.5:
+            crossover = r["guesses"]
+            break
+
+    deciles = []
+    for d in range(10):
+        sl = rows[len(rows) * d // 10 : len(rows) * (d + 1) // 10]
+        if not sl:
+            continue
+        deciles.append(
+            {
+                "guesses_range": [sl[0]["guesses"], sl[-1]["guesses"]],
+                "bucket_ms_p50": round(
+                    float(np.median([r["bucket_ms"] for r in sl])), 2
+                ),
+                "race_ms_p50": round(
+                    float(np.median([r["race_ms"] for r in sl])), 2
+                ),
+                "race_wins": sum(r["race_ms"] < r["bucket_ms"] for r in sl),
+                "n": len(sl),
+            }
+        )
+    print(
+        json.dumps(
+            {
+                "platform": jax.default_backend(),
+                "mesh_devices": int(mesh.devices.size),
+                "states_per_device": STATES,
+                "boards": len(rows),
+                "race_wins_total": len(wins),
+                "crossover_guesses": crossover,
+                "deciles": deciles,
+            },
+            indent=2,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Build the adversarial deep-search 9×9 corpus (VERDICT r3 task 3).
+
+The frontier race (parallel/frontier.py) exists for boards whose serial DFS
+tail dwarfs the race's seeding/collective overhead — the analog of the
+reference's distributed dispatch existing to beat its local solve
+(reference node.py:427-475). The committed hard corpus averages ~1 guess
+per board under the serving config (locked sets + waves), so nothing in it
+can ever make the race win; this script mines the generator for the deep
+tail instead:
+
+  1. generate certified-unique minimal-ish puzzles (blank-down, ~21-28
+     clues) across many seeds;
+  2. solve them all with the serving-config XLA solver on CPU and rank by
+     per-board guesses (platform-independent difficulty);
+  3. keep the top slice as ``corpus_9x9_adversarial_{K}.npz`` with the
+     guess counts stored alongside.
+
+Run on CPU (no TPU claim): ``python benchmarks/make_adversarial.py``.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CANDIDATES = int(os.environ.get("ADV_CANDIDATES", "4096"))
+KEEP = int(os.environ.get("ADV_KEEP", "128"))
+HOLES = int(os.environ.get("ADV_HOLES", "64"))  # upper bound; unique caps it
+SEED = int(os.environ.get("ADV_SEED", "20260730"))
+CHUNK = 1024
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+    from sudoku_solver_distributed_tpu.ops import (
+        SPEC_9,
+        serving_config,
+        solve_batch,
+    )
+
+    cfg = serving_config(9)
+    solve = jax.jit(lambda g: solve_batch(g, SPEC_9, **cfg))
+
+    boards_all, guesses_all = [], []
+    t0 = time.time()
+    for k in range(0, CANDIDATES, CHUNK):
+        n = min(CHUNK, CANDIDATES - k)
+        boards = generate_batch(n, HOLES, seed=SEED + k, unique=True)
+        res = jax.block_until_ready(solve(jnp.asarray(boards)))
+        assert bool(np.asarray(res.solved).all()), "unsolved candidate?!"
+        boards_all.append(boards)
+        guesses_all.append(np.asarray(res.guesses))
+        print(
+            f"# {k + n}/{CANDIDATES} candidates, {time.time() - t0:.0f}s",
+            flush=True,
+        )
+    boards = np.concatenate(boards_all)
+    guesses = np.concatenate(guesses_all)
+
+    order = np.argsort(-guesses)
+    top = order[:KEEP]
+    out = os.path.join(REPO, "benchmarks", f"corpus_9x9_adversarial_{KEEP}.npz")
+    np.savez_compressed(
+        out, boards=boards[top], guesses=guesses[top]
+    )
+    clues = (boards[top] > 0).sum(axis=(1, 2))
+    print(
+        json.dumps(
+            {
+                "kept": KEEP,
+                "candidates": CANDIDATES,
+                "guesses_max": int(guesses.max()),
+                "guesses_p50_kept": float(np.percentile(guesses[top], 50)),
+                "guesses_min_kept": int(guesses[top].min()),
+                "clues_min": int(clues.min()),
+                "clues_p50": float(np.percentile(clues, 50)),
+                "corpus": os.path.basename(out),
+                "elapsed_s": round(time.time() - t0, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,228 @@
+"""One-process TPU measurement session (round 3).
+
+The repo's only TPU is a single pooled v5e behind a tunnel that grants one
+claim at a time, and killing a mid-compile client wedges the claim pool-side
+(docs/OPERATIONS.md).  So ALL on-chip questions for a session run from this
+ONE process, patiently, in priority order, appending a JSON line per
+completed measurement to ``benchmarks/tpu_session_r3.jsonl`` so partial
+progress survives anything that happens later in the session:
+
+  1. 9x9 headline throughput (the bench config) — the driver-verifiable
+     number that VERDICT.md round 2 flagged as the record gap.
+  2. Serving-config splits: naked_pairs on/off, light_waves — resolves the
+     bench/serving divergence (VERDICT weak #1) by measurement.
+  3. Per-size throughput: 16x16 and 25x25 (largest committed corpus found),
+     including a small waves sweep (their round-2 numbers were waves=1).
+  4. Single-board blocking solve time (device-side latency component).
+  5. Pallas kernel compile attempt — LAST, because a failed/hung Mosaic
+     compile must not cost the numbers above (round-2 postmortem:
+     ROADMAP.md "Known gaps" #1).
+
+Run with NO timeout wrapper:  nohup python benchmarks/tpu_session.py &
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "benchmarks", "tpu_session_r3.jsonl")
+
+
+def emit(record):
+    record["t"] = round(time.time(), 1)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+    print("EMIT", json.dumps(record), flush=True)
+
+
+def time_solve(solve, dev_boards, batch, repeats=5):
+    """bench.py methodology: sustained (async back-to-back) + blocking best."""
+    import jax
+
+    t0 = time.perf_counter()
+    outs = [solve(dev_boards) for _ in range(repeats)]
+    jax.block_until_ready(outs[-1])
+    sustained = (time.perf_counter() - t0) / repeats
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = jax.block_until_ready(solve(dev_boards))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return {
+        "pps": round(batch / min(best, sustained), 1),
+        "sustained_ms": round(sustained * 1000, 2),
+        "blocking_best_ms": round(best * 1000, 2),
+        "iters": int(res.iters),
+    }
+
+
+def main():
+    emit({"phase": "start", "pid": os.getpid()})
+
+    import jax
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    emit(
+        {
+            "phase": "backend_up",
+            "init_s": round(time.perf_counter() - t0, 1),
+            "devices": [str(d) for d in devs],
+        }
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.ops import solve_batch, spec_for_size
+
+    def load_corpus(size):
+        import glob
+
+        paths = glob.glob(
+            os.path.join(REPO, "benchmarks", f"corpus_{size}x{size}_hard_*.npz")
+        )
+        best_path = max(
+            paths, key=lambda p: int(p.rsplit("_", 1)[1].split(".")[0])
+        )
+        return np.load(best_path)["boards"], os.path.basename(best_path)
+
+    def run_config(size, boards, name, **kw):
+        spec = spec_for_size(size)
+        solve = jax.jit(lambda g: solve_batch(g, spec, **kw))
+        dev = jnp.asarray(boards)
+        t0 = time.perf_counter()
+        res = jax.block_until_ready(solve(dev))
+        compile_s = round(time.perf_counter() - t0, 1)
+        solved = bool(np.asarray(res.solved).all())
+        stats = time_solve(solve, dev, len(boards))
+        emit(
+            {
+                "phase": "measure",
+                "name": name,
+                "size": size,
+                "batch": len(boards),
+                "compile_s": compile_s,
+                "all_solved": solved,
+                **stats,
+            }
+        )
+        return stats
+
+    # ---- phase 1: 9x9 headline (the exact bench.py config) ----------------
+    b9, corpus9 = load_corpus(9)
+    emit({"phase": "corpus", "size": 9, "file": corpus9, "n": len(b9)})
+    base9 = dict(
+        max_iters=4096, max_depth=(32, 81), locked_candidates=True, waves=3,
+        naked_pairs=False,
+    )
+    try:
+        run_config(9, b9, "headline_9x9_waves3_pairsoff", **base9)
+    except Exception as e:  # noqa: BLE001 — record, keep the session alive
+        emit({"phase": "error", "name": "headline", "err": repr(e)[:500]})
+        raise  # headline failing means the backend is sick; stop cleanly
+
+    # ---- phase 2: serving-config splits on 9x9 ---------------------------
+    splits = [
+        ("9x9_waves3_pairsON", {**base9, "naked_pairs": True}),
+        ("9x9_light_waves4", {**base9, "waves": 4, "light_waves": True}),
+        ("9x9_light_waves5", {**base9, "waves": 5, "light_waves": True}),
+        ("9x9_waves2_pairsoff", {**base9, "waves": 2}),
+        ("9x9_waves4_pairsoff", {**base9, "waves": 4}),
+    ]
+    for name, kw in splits:
+        try:
+            run_config(9, b9, name, **kw)
+        except Exception as e:  # noqa: BLE001
+            emit({"phase": "error", "name": name, "err": repr(e)[:500]})
+
+    # ---- phase 3: per-size throughput ------------------------------------
+    for size, depth, iters in ((16, (64, 256), 16384), (25, None, 65536)):
+        try:
+            bs, cname = load_corpus(size)
+            emit({"phase": "corpus", "size": size, "file": cname, "n": len(bs)})
+            for waves in (1, 2, 3):
+                run_config(
+                    size, bs, f"{size}x{size}_waves{waves}",
+                    max_iters=iters, max_depth=depth,
+                    locked_candidates=True, waves=waves, naked_pairs=False,
+                )
+            run_config(
+                size, bs, f"{size}x{size}_waves1_pairsON",
+                max_iters=iters, max_depth=depth,
+                locked_candidates=True, waves=1, naked_pairs=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            emit({"phase": "error", "name": f"size{size}", "err": repr(e)[:500]})
+
+    # ---- phase 4: single-board blocking solve (device latency component) --
+    try:
+        spec = spec_for_size(9)
+        solve1 = jax.jit(
+            lambda g: solve_batch(
+                g, spec, max_iters=4096, max_depth=(32, 81),
+                locked_candidates=True, waves=1, naked_pairs=True,
+            )
+        )
+        one = jnp.asarray(b9[:1])
+        jax.block_until_ready(solve1(one))  # compile
+        lat = []
+        for i in range(40):
+            one = jnp.asarray(b9[i : i + 1])
+            t0 = time.perf_counter()
+            jax.block_until_ready(solve1(one))
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat = np.asarray(lat)
+        emit(
+            {
+                "phase": "device_latency_1board",
+                "p50_ms": round(float(np.percentile(lat, 50)), 2),
+                "p95_ms": round(float(np.percentile(lat, 95)), 2),
+                "min_ms": round(float(lat.min()), 2),
+                "note": "blocking 1-board solve incl. tunnel RTT per call",
+            }
+        )
+    except Exception as e:  # noqa: BLE001
+        emit({"phase": "error", "name": "latency1", "err": repr(e)[:500]})
+
+    # ---- phase 5: pallas compile attempt (LAST; may hang or crash) --------
+    try:
+        emit({"phase": "pallas_attempt_start"})
+        from sudoku_solver_distributed_tpu.ops.pallas_solver import (
+            solve_batch_pallas,
+        )
+
+        spec = spec_for_size(9)
+        small = jnp.asarray(b9[:256])
+        t0 = time.perf_counter()
+        res = jax.block_until_ready(
+            solve_batch_pallas(small, spec, max_depth=(32, 81))
+        )
+        compile_s = round(time.perf_counter() - t0, 1)
+        ok = bool(np.asarray(res.solved).all())
+        solve_p = jax.jit(
+            lambda g: solve_batch_pallas(g, spec, max_depth=(32, 81))
+        )
+        jax.block_until_ready(solve_p(jnp.asarray(b9)))
+        stats = time_solve(solve_p, jnp.asarray(b9), len(b9))
+        emit(
+            {
+                "phase": "pallas_result",
+                "compile_s": compile_s,
+                "all_solved_256": ok,
+                **stats,
+            }
+        )
+    except Exception as e:  # noqa: BLE001
+        emit({"phase": "pallas_error", "err": repr(e)[:800]})
+
+    emit({"phase": "done"})
+
+
+if __name__ == "__main__":
+    main()

@@ -4,9 +4,37 @@
 node exactly as against the reference repo — same flags, same UDP protocol,
 same HTTP surface — with the TPU engine behind it. See
 sudoku_solver_distributed_tpu/net/cli.py for the extension flags.
+
+Also importable for its classes, like the reference module (reference
+node.py:21, 134): ``from node import P2PNode, SudokuSolver, SolverEngine``.
+Everything resolves lazily (PEP 562) so ``import node`` stays free of jax
+and the engine stack until an attribute is actually touched — cli.main must
+parse ``--platform`` before anything initializes a backend.
 """
 
-from sudoku_solver_distributed_tpu.net.cli import main
+__all__ = ["main", "P2PNode", "SudokuSolver", "SolverEngine"]
+
+_LAZY = {
+    "main": ("sudoku_solver_distributed_tpu.net.cli", "main"),
+    "P2PNode": ("sudoku_solver_distributed_tpu.net.node", "P2PNode"),
+    "SudokuSolver": (
+        "sudoku_solver_distributed_tpu.net.solver_api",
+        "SudokuSolver",
+    ),
+    "SolverEngine": ("sudoku_solver_distributed_tpu.engine", "SolverEngine"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'node' has no attribute {name!r}")
+
 
 if __name__ == "__main__":
+    from sudoku_solver_distributed_tpu.net.cli import main
+
     main()

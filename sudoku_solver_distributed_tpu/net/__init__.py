@@ -16,6 +16,7 @@ from .stats import StatsGossip
 from .membership import Membership
 from .node import P2PNode
 from .http_api import make_http_server
+from .solver_api import SudokuSolver
 
 __all__ = [
     "Msg",
@@ -25,5 +26,6 @@ __all__ = [
     "StatsGossip",
     "Membership",
     "P2PNode",
+    "SudokuSolver",
     "make_http_server",
 ]

@@ -103,6 +103,22 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = off: bucket-1 batch solve)",
     )
     parser.add_argument(
+        "--frontier-route",
+        default="auto",
+        choices=["auto", "always"],
+        help="with --frontier: 'auto' (default) answers easy requests from "
+        "a short bucket-path probe and escalates only deep-search boards "
+        "to the race (measured crossover policy, engine.py); 'always' "
+        "races every request",
+    )
+    parser.add_argument(
+        "--frontier-escalate-iters",
+        type=int,
+        default=512,
+        help="auto-route probe budget in lockstep iterations before a "
+        "request escalates to the frontier race",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         choices=["cpu", "tpu"],
@@ -151,6 +167,9 @@ def main(argv=None) -> None:
 
         kwargs["frontier_mesh"] = default_mesh()
         kwargs["frontier_states_per_device"] = args.frontier
+    if args.frontier > 0:
+        kwargs["frontier_route"] = args.frontier_route
+        kwargs["frontier_escalate_iters"] = args.frontier_escalate_iters
     engine = SolverEngine(**kwargs)
     if args.frontier > 0 and multi_host:
         # The racer is a collective over the global mesh: every host enters
@@ -159,16 +178,21 @@ def main(argv=None) -> None:
         # Non-leader hosts serve /solve from their local bucket path.
         from ..parallel import FrontierServingLoop, default_mesh
 
+        # every solver knob mirrors the engine's resolved SERVING_CONFIG
+        # values, so the race serves the exact benched configuration
         serving_loop = FrontierServingLoop(
             default_mesh(),
             engine.spec,
             states_per_device=args.frontier,
+            max_depth=engine.max_depth,
             locked=engine.locked_candidates,
             waves=engine.waves,
+            naked_pairs=engine.naked_pairs,
         )
         serving_loop.start()
         if serving_loop.is_leader:
             engine.frontier_runner = serving_loop.solve
+            engine.frontier_loop = serving_loop
     from ..utils.profiling import RequestMetrics
 
     node = P2PNode(

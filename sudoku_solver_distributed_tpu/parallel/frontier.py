@@ -214,13 +214,16 @@ def _make_racer(
     max_depth,
     locked: bool = False,
     waves: int = 1,
+    naked_pairs: Optional[bool] = None,
 ):
     """Compile the shard_map race (cached). A staged (tuple) ``max_depth``
     collapses to its deepest stage here — the single choke point, so engine
     warmup and serving land on the same cache entry."""
     if isinstance(max_depth, (tuple, list)):
         max_depth = max(max_depth)
-    return _make_racer_cached(mesh, spec, max_iters, max_depth, locked, waves)
+    return _make_racer_cached(
+        mesh, spec, max_iters, max_depth, locked, waves, naked_pairs
+    )
 
 
 @lru_cache(maxsize=None)
@@ -231,13 +234,13 @@ def _make_racer_cached(
     max_depth: Optional[int],
     locked: bool = False,
     waves: int = 1,
+    naked_pairs: Optional[bool] = None,
 ):
     """Compile the shard_map race: lockstep DFS with per-iteration early exit.
 
-    Cached on (mesh, spec, max_iters, max_depth, locked) — a fresh closure
-    per call would re-trace under jit on every frontier-routed request;
-    warmup (engine.py) and serving must pass identical values to share the
-    compiled program."""
+    Cached on every solver knob — a fresh closure per call would re-trace
+    under jit on every frontier-routed request; warmup (engine.py) and
+    serving must pass identical values to share the compiled program."""
 
     from jax.sharding import PartitionSpec as P
 
@@ -259,7 +262,7 @@ def _make_racer_cached(
 
         def body(carry):
             st, _ = carry
-            st = S.step(st, spec, locked, waves)
+            st = S.step(st, spec, locked, waves, naked_pairs=naked_pairs)
             local_hit = (st.status == S.SOLVED).any()
             found = jax.lax.psum(local_hit.astype(jnp.int32), "data") > 0
             return st, found
@@ -305,6 +308,7 @@ def frontier_solve(
     max_depth: Optional[int] = None,
     locked: bool = False,
     waves: int = 1,
+    naked_pairs: Optional[bool] = None,
 ) -> Tuple[Optional[list], dict]:
     """Solve one (hard) board by racing its search subtrees across the mesh.
 
@@ -343,7 +347,9 @@ def frontier_solve(
             _unsat_pad(spec), (total - len(states), spec.size, spec.size)
         )
         states = np.concatenate([states, pad], axis=0)
-    racer = _make_racer(mesh, spec, max_iters, max_depth, locked, waves)
+    racer = _make_racer(
+        mesh, spec, max_iters, max_depth, locked, waves, naked_pairs
+    )
     if len(mesh.devices.flatten()) > len(jax.local_devices()):
         # multi-host mesh (serving_loop.py): every host ran the same
         # deterministic seeding and holds the full identical states array;

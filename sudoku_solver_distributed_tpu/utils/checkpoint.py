@@ -114,7 +114,7 @@ def load_solver_state(
 
 @partial(
     jax.jit,
-    static_argnames=("spec", "chunk", "max_iters", "locked", "waves"),
+    static_argnames=("spec", "chunk", "max_iters", "locked", "waves", "naked_pairs"),
 )
 def _run_chunk(
     state: S._State,
@@ -123,6 +123,7 @@ def _run_chunk(
     max_iters: int,
     locked: bool = False,
     waves: int = 1,
+    naked_pairs: bool | None = None,
 ):
     """Advance every RUNNING board by ≤``chunk`` lockstep iterations."""
     target = jax.numpy.minimum(state.iters + chunk, max_iters)
@@ -131,7 +132,9 @@ def _run_chunk(
         return ((s.status == S.RUNNING).any()) & (s.iters < target)
 
     return jax.lax.while_loop(
-        cond, lambda s: S.step(s, spec, locked, waves), state
+        cond,
+        lambda s: S.step(s, spec, locked, waves, naked_pairs=naked_pairs),
+        state,
     )
 
 
@@ -147,6 +150,7 @@ def solve_batch_resumable(
     sharding=None,
     locked: bool = False,
     waves: int = 1,
+    naked_pairs: bool | None = None,
 ) -> S.SolveResult:
     """Solve a batch with periodic checkpoints; resume if one exists.
 
@@ -206,7 +210,10 @@ def solve_batch_resumable(
 
     while True:
         state = jax.block_until_ready(
-            _run_chunk(state, spec, chunk_iters, max_iters, locked, waves)
+            _run_chunk(
+                state, spec, chunk_iters, max_iters, locked, waves,
+                naked_pairs=naked_pairs,
+            )
         )
         done = not bool(np.asarray(state.status == S.RUNNING).any())
         if done:

@@ -162,3 +162,31 @@ def test_engine_reports_capped_not_unsat():
     _, ok2, info2 = lo.solve_batch_np(bad[None])
     assert not bool(ok2.any())
     assert info2["capped"] == 0
+
+
+def test_deep_retry_repacks_only_capped_lanes():
+    """One adversarial board in a large bucket must NOT re-dispatch the whole
+    bucket at deep_retry_factor x iterations — the capped lanes re-pack into
+    the smallest covering bucket for the deep pass (ADVICE r2)."""
+    from conftest import README_PUZZLE
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.models import (
+        generate_batch,
+        oracle_is_valid_solution,
+    )
+
+    eng = SolverEngine(buckets=(1, 8), max_iters=4, deep_retry_factor=2048)
+    deep_shapes = []
+    orig_deep = eng._solve_deep
+    eng._solve_deep = lambda g: (deep_shapes.append(tuple(g.shape)), orig_deep(g))[1]
+
+    # 7 trivial boards (one hole: solved in a sweep) + the 8-clue README
+    # board, which cannot finish within 4 iterations
+    easy = generate_batch(7, 1, seed=3)
+    boards = np.concatenate([easy, np.asarray(README_PUZZLE, np.int32)[None]])
+    sols, ok, info = eng.solve_batch_np(boards)
+    assert bool(ok.all()) and info["capped"] == 0
+    assert oracle_is_valid_solution(sols[-1].tolist())
+    # the deep pass ran, and on the 1-bucket — not the full 8-bucket
+    assert deep_shapes == [(1, 9, 9)]

@@ -33,7 +33,9 @@ def run_bench(env_extra, timeout=480):
 
 
 def check_artifact(artifact):
-    assert set(artifact) == {"metric", "value", "unit", "vs_baseline"}
+    # the driver's four required keys; extra evidence keys (e.g. the latency
+    # mode's server-side percentiles) are allowed
+    assert set(artifact) >= {"metric", "value", "unit", "vs_baseline"}
     assert artifact["value"] > 0 and artifact["vs_baseline"] > 0
 
 
@@ -63,6 +65,8 @@ def test_latency_mode_smoke():
     check_artifact(artifact)
     assert artifact["metric"] == "p50_solve_http_latency_readme9x9"
     assert artifact["unit"] == "ms"
+    # server-side (RTT-excluded) evidence must ride along (VERDICT r2 #4)
+    assert artifact["server_p50_ms"] > 0
 
 
 def test_farm_mode_smoke():
@@ -76,3 +80,50 @@ def test_farm_mode_smoke():
     check_artifact(artifact)
     assert artifact["metric"] == "p50_solve_http_3node_farm_5hole9x9"
     assert "complete" in stderr or "completeness" in stderr
+
+
+def test_throughput_retry_survives_init_hang(tmp_path):
+    """VERDICT r2 missing #1: a stale-claim init hang on the first attempt
+    must not kill the bench — the retry wrapper's second child lands the
+    number. The hang is simulated (BENCH_FAKE_INIT_HANG_ONCE); staging a
+    real one would wedge the actual pooled claim (docs/OPERATIONS.md)."""
+    artifact, stderr = run_bench(
+        {
+            "BENCH_BATCH": "64",
+            "BENCH_REPEATS": "2",
+            "BENCH_PLATFORM": "cpu",
+            "BENCH_FAKE_INIT_HANG_ONCE": str(tmp_path / "hang_once.flag"),
+            "BENCH_INIT_TIMEOUT_S": "3",
+            "BENCH_TOTAL_BUDGET_S": "300",
+            "BENCH_RETRY_BACKOFF_S": "0.1",
+        }
+    )
+    check_artifact(artifact)
+    assert artifact["metric"] == "puzzles_per_sec_per_chip_hard9x9"
+    assert "attempt 1 hit the init watchdog" in stderr
+
+
+def test_throughput_retry_gives_up_within_budget(tmp_path):
+    """When the claim never frees, the wrapper must exit rc=3 before the
+    driver's own window would, not loop forever."""
+    import subprocess
+    import sys
+
+    env = dict(
+        os.environ,
+        BENCH_BATCH="64",
+        BENCH_PLATFORM="cpu",
+        BENCH_FAKE_INIT_HANG_ALWAYS="1",  # every attempt hits the watchdog
+        BENCH_INIT_TIMEOUT_S="2",
+        BENCH_TOTAL_BUDGET_S="6",
+        BENCH_RETRY_BACKOFF_S="0.1",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 3
+    assert "giving up" in proc.stderr

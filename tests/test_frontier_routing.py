@@ -1,0 +1,113 @@
+"""Per-request frontier routing (VERDICT r3 task 3).
+
+With a frontier mesh configured, ``frontier_route="auto"`` (the default)
+answers the easy mass of requests from a short bucket-path probe and
+escalates only deep-search boards to the race. Rationale (measured,
+benchmarks/exp_frontier_crossover.py): the README 8-clue board finishes in
+~105 lockstep iterations — a ~3 ms bucket solve — while the race costs
+~45 ms on the virtual CPU mesh; racing *everything* (round-2's global
+--frontier flag) made the common case slower. The race only pays off where
+serial search dwarfs its seeding overhead, so that's exactly — and only —
+what gets routed to it.
+"""
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    oracle_is_valid_solution,
+)
+from sudoku_solver_distributed_tpu.parallel import default_mesh
+
+
+def _spy_engine(**kw):
+    eng = SolverEngine(
+        buckets=(1,),
+        frontier_mesh=default_mesh(),
+        frontier_states_per_device=8,
+        **kw,
+    )
+    calls = []
+    orig = eng._frontier_solve
+
+    def spy(arr):
+        out = orig(arr)
+        calls.append(out[1])
+        return out
+
+    eng._frontier_solve = spy
+    return eng, calls
+
+
+def test_auto_route_easy_board_stays_on_bucket_path(readme_puzzle):
+    eng, race_calls = _spy_engine()  # default: auto, 512-iteration probe
+    solution, info = eng.solve_one(readme_puzzle)
+    assert oracle_is_valid_solution(solution)
+    assert info["routed"] == "bucket-quick"
+    assert race_calls == []
+    assert eng.frontier_escalations == 0
+    assert eng.solved_puzzles == 1 and eng.validations > 0
+
+
+def test_auto_route_deep_board_escalates_to_race(readme_puzzle):
+    # 4-iteration probe: the README board (~105 iters) becomes "deep"
+    eng, race_calls = _spy_engine(frontier_escalate_iters=4)
+    solution, info = eng.solve_one(readme_puzzle)
+    assert oracle_is_valid_solution(solution)
+    assert info["frontier"] is True
+    assert len(race_calls) == 1
+    assert eng.frontier_escalations == 1
+    # the probe's sweeps are billed even though the race answered
+    assert eng.validations > race_calls[0]["validations"]
+
+
+def test_auto_route_unsat_answered_by_probe():
+    board = np.zeros((9, 9), np.int32)
+    board[0, 0] = board[0, 1] = 5  # row contradiction: UNSAT in one sweep
+    eng, race_calls = _spy_engine()
+    solution, info = eng.solve_one(board)
+    assert solution is None
+    assert info["routed"] == "bucket-quick"
+    assert race_calls == []
+
+
+def test_explicit_frontier_true_bypasses_probe(readme_puzzle):
+    eng, race_calls = _spy_engine()
+    solution, info = eng.solve_one(readme_puzzle, frontier=True)
+    assert oracle_is_valid_solution(solution)
+    assert info["frontier"] is True
+    assert len(race_calls) == 1
+    assert eng.frontier_escalations == 0  # routed explicitly, not escalated
+
+
+def test_always_route_races_everything(readme_puzzle):
+    eng, race_calls = _spy_engine(frontier_route="always")
+    solution, info = eng.solve_one(readme_puzzle)
+    assert oracle_is_valid_solution(solution)
+    assert info["frontier"] is True and len(race_calls) == 1
+
+
+def test_route_validation_and_health():
+    with pytest.raises(ValueError, match="frontier_route"):
+        SolverEngine(buckets=(1,), frontier_route="sometimes")
+    eng, _ = _spy_engine(frontier_escalate_iters=4)
+    h = eng.health()
+    assert h["frontier_route"] == "auto"
+    assert h["frontier_escalations"] == 0
+    board = generate_batch(1, 40, seed=11, unique=True)[0]
+    eng.solve_one(board.tolist())  # easy: stays on the probe
+    assert eng.health()["frontier_escalations"] in (0, 1)
+
+
+def test_worker_cell_tasks_never_probe_or_race(readme_puzzle):
+    """frontier=False (the P2P worker's per-cell path) must keep using the
+    full bucket path — no probe, no race."""
+    eng, race_calls = _spy_engine()
+    quick_calls = []
+    orig = eng._probe_quick
+    eng._probe_quick = lambda arr: (quick_calls.append(1), orig(arr))[1]
+    solution, info = eng.solve_one(readme_puzzle, frontier=False)
+    assert oracle_is_valid_solution(solution)
+    assert race_calls == [] and quick_calls == []

@@ -243,7 +243,7 @@ def test_two_process_cli_frontier_serving_loop():
     http0, http1 = _free_tcp_port(), _free_tcp_port()
     udp0, udp1 = _free_tcp_port(), _free_tcp_port()
     common = ["-h", "0", "--buckets", "1",
-              "--frontier", "4",
+              "--frontier", "4", "--frontier-route", "always",
               "--coordinator", coord, "--num-hosts", "2"]
     import tempfile
 

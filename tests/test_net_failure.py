@@ -150,9 +150,12 @@ def test_metrics_endpoint_opt_in(engine):
         assert exc.value.code == 404
         assert json.load(exc.value) == {"error": "Invalid endpoint"}
 
-        # opt-in: empty until a request is recorded, then percentiles appear
+        # opt-in: engine health is always present; route percentiles appear
+        # only once a request is recorded
         with urllib.request.urlopen(f"{base_on}/metrics", timeout=5) as r:
-            assert json.load(r) == {}
+            m0 = json.load(r)
+        assert set(m0) == {"engine"}
+        assert m0["engine"]["frontier_fallbacks"] == 0
         req = urllib.request.Request(
             f"{base_on}/solve",
             data=json.dumps({"sudoku": [[0] * 9 for _ in range(9)]}).encode(),

@@ -256,6 +256,10 @@ def test_http_solve_frontier_path(readme_puzzle):
         buckets=(1,),
         frontier_mesh=default_mesh(),
         frontier_states_per_device=8,
+        # pin the race as the serving path: this test proves the race CAN
+        # serve /solve; the auto routing policy has its own tests
+        # (tests/test_frontier_routing.py)
+        frontier_route="always",
     )
     eng.warmup()
     # warmup compiles the race without polluting serving counters

@@ -157,3 +157,24 @@ def test_pallas_auto_stages_oversized_default_depth(monkeypatch):
     assert int(res.status[0]) == SOLVED
     ref = solve_batch(jnp.asarray(batch), SPEC_9)
     np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(ref.grid))
+
+
+def test_pallas_explicit_int_depth_over_budget_stages(monkeypatch):
+    """An EXPLICIT int max_depth whose stack exceeds the VMEM budget must not
+    compile an over-VMEM kernel (ADVICE r2): it stages like the None default
+    — fit-depth kernel stage + over-budget stage routed to the XLA solver —
+    keeping the caller's depth guarantee."""
+    from sudoku_solver_distributed_tpu.ops import pallas_solver as ps
+
+    batch = np.zeros((1, 9, 9), np.int32)          # deepest 9×9 search
+    monkeypatch.setattr(
+        ps, "_VMEM_STACK_BUDGET", ps._stack_bytes(8, SPEC_9, 1)
+    )
+    # depth 81 is over the shrunk budget; the old behavior compiled it flat
+    res = ps.solve_batch_pallas(
+        jnp.asarray(batch, jnp.int32), SPEC_9, block=1,
+        max_depth=81, interpret=True,
+    )
+    assert int(res.status[0]) == SOLVED
+    ref = solve_batch(jnp.asarray(batch), SPEC_9)
+    np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(ref.grid))

@@ -1,0 +1,54 @@
+"""ops.SERVING_CONFIG is the single source of truth (VERDICT r2 weak #1).
+
+The serving engine, bench.py, and __graft_entry__ must all run the same
+measured-best solver configuration. bench.py and __graft_entry__.entry()
+consume ``serving_config()`` directly (greppable); this test pins the
+third consumer — SolverEngine defaults — to the same values, per size.
+"""
+
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.ops import (
+    SERVING_CONFIG,
+    serving_config,
+    spec_for_size,
+)
+
+
+@pytest.mark.parametrize("size", sorted(SERVING_CONFIG))
+def test_engine_defaults_follow_serving_config(size):
+    eng = SolverEngine(spec=spec_for_size(size), buckets=(1,))
+    cfg = SERVING_CONFIG[size]
+    assert eng.max_depth == cfg["max_depth"]
+    assert eng.waves == cfg["waves"]
+    assert eng.locked_candidates == cfg["locked_candidates"]
+    assert eng.naked_pairs == cfg["naked_pairs"]
+    assert eng.max_iters == cfg["max_iters"]
+
+
+def test_explicit_overrides_still_win():
+    eng = SolverEngine(
+        buckets=(1,), max_depth=None, waves=2, naked_pairs=True, max_iters=99
+    )
+    assert eng.max_depth is None  # explicit None = kernel's flat default
+    assert eng.waves == 2 and eng.naked_pairs is True and eng.max_iters == 99
+
+
+def test_serving_config_returns_copy_and_validates():
+    cfg = serving_config(9)
+    cfg["waves"] = 99
+    assert SERVING_CONFIG[9]["waves"] != 99
+    with pytest.raises(ValueError, match="no serving config"):
+        serving_config(7)
+
+
+def test_entry_and_bench_consume_serving_config():
+    """The other two consumers import serving_config — no stray config
+    tuples (grep-level check, kept as a test so it can't silently rot)."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for fname in ("bench.py", "__graft_entry__.py"):
+        src = open(os.path.join(repo, fname)).read()
+        assert "serving_config" in src, f"{fname} bypasses ops.SERVING_CONFIG"
