@@ -42,7 +42,7 @@ import time
 # BASELINE.json's ≥100k/chip; larger boards scaled as rough cell-count-cubed
 # stretch goals — no reference numbers exist at any size, BASELINE.md).
 BENCH_SIZE = int(os.environ.get("BENCH_SIZE", "9"))
-_DEFAULT_BATCH = {9: 16384, 16: 2048, 25: 128}
+_DEFAULT_BATCH = {9: 16384, 16: 2048, 25: 512}
 if BENCH_SIZE not in _DEFAULT_BATCH:
     sys.exit(f"BENCH_SIZE must be one of {sorted(_DEFAULT_BATCH)}, got {BENCH_SIZE}")
 BENCH_BATCH = int(

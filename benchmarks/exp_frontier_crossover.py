@@ -11,10 +11,11 @@ control slice of the ordinary hard corpus, measure per board:
   * iters   — the board's lockstep iteration count (platform-independent
               difficulty, what the auto-route probe actually observes).
 
-Output: a per-decile table of (iters, bucket_ms, race_ms) + the measured
-crossover iteration count — the smallest iters bucket where the race's
-median beats the bucket path's. That number justifies (or corrects)
-``SolverEngine(frontier_escalate_iters=...)``.
+Output: a per-decile table of (iters, guesses, bucket_ms, race_ms) + the
+measured crossover in LOCKSTEP ITERATIONS — the unit the auto-route probe
+actually observes — i.e. the smallest per-board iteration count from which
+the race consistently beats the bucket path. That number justifies (or
+corrects) ``SolverEngine(frontier_escalate_iters=...)`` (default 512).
 
 Platform note: on the virtual CPU mesh the 8 shards serialize on one core,
 so race_ms is pessimistic there; run on real hardware for the serving
@@ -50,12 +51,19 @@ def main():
         frontier_solve,
     )
 
-    adv_path = os.path.join(REPO, "benchmarks", "corpus_9x9_adversarial_128.npz")
+    # deepest available adversarial corpus: the hill-climbed deep set
+    # (benchmarks/mine_deep.py) if mined, else the random-minimal harvest
+    adv_path = os.path.join(REPO, "benchmarks", "corpus_9x9_deep_128.npz")
+    if not os.path.exists(adv_path):
+        adv_path = os.path.join(
+            REPO, "benchmarks", "corpus_9x9_adversarial_128.npz"
+        )
     adv = np.load(adv_path)
     hard = np.load(
         os.path.join(REPO, "benchmarks", "corpus_9x9_hard_4096.npz")
     )["boards"][:CONTROL]
     boards = np.concatenate([hard, adv["boards"]])
+    print(f"# adversarial corpus: {os.path.basename(adv_path)}", file=sys.stderr)
 
     mesh = default_mesh()
     eng = SolverEngine(buckets=(1,))  # plain bucket path, serving config
@@ -70,6 +78,23 @@ def main():
     )
     # warm the race on the first board
     frontier_solve(boards[-1], mesh, **race_kw)
+
+    # per-board lockstep iterations under the exact bucket-1 serving view
+    # (waves_eff=1) — the quantity the auto-route probe compares against
+    # frontier_escalate_iters; a (1,N,N) solve's res.iters IS that board's
+    # count (no batch mixing)
+    from sudoku_solver_distributed_tpu.ops import (
+        SPEC_9,
+        serving_config,
+        solve_batch,
+    )
+
+    iters_cfg = dict(serving_config(9), waves=1)
+    iters_solve = jax.jit(lambda g: solve_batch(g, SPEC_9, **iters_cfg))
+
+    def board_iters(board):
+        res = jax.block_until_ready(iters_solve(jnp.asarray(board[None])))
+        return int(res.iters)
 
     rows = []
     for k, board in enumerate(boards):
@@ -90,6 +115,7 @@ def main():
                 "cls": "hard" if k < len(hard) else "adv",
                 "clues": int((board > 0).sum()),
                 "guesses": int(info["guesses"]),
+                "iters": board_iters(board),
                 "bucket_ms": round(min(bucket_ms), 2),
                 "race_ms": round(min(race_ms), 2),
             }
@@ -97,17 +123,29 @@ def main():
         if k % 16 == 0:
             print(f"# {k + 1}/{len(boards)}", file=sys.stderr, flush=True)
 
-    # difficulty proxy: bucket-path guesses (monotone with search depth)
-    rows.sort(key=lambda r: r["guesses"])
+    # difficulty axis: per-board lockstep iterations (what the probe sees)
+    rows.sort(key=lambda r: r["iters"])
     wins = [r for r in rows if r["race_ms"] < r["bucket_ms"]]
+    # Crossover: the smallest iteration level L (scanning GROUP boundaries
+    # only — a split inside a run of equal values would verify fractions no
+    # iters-based policy can reproduce) where the race wins >=60% of boards
+    # at-or-above L and <40% below. If the race wins everywhere (expected
+    # on a big mesh), the first group's level is the honest answer, not
+    # None.
     crossover = None
-    # smallest difficulty from which the race wins the MAJORITY of boards
-    for i, r in enumerate(rows):
-        tail = rows[i:]
-        tail_wins = sum(t["race_ms"] < t["bucket_ms"] for t in tail)
-        if tail and tail_wins / len(tail) > 0.5:
-            crossover = r["guesses"]
-            break
+    win = lambda t: t["race_ms"] < t["bucket_ms"]  # noqa: E731
+    if rows and sum(map(win, rows)) / len(rows) >= 0.95:
+        crossover = rows[0]["iters"]
+    else:
+        for i in range(1, len(rows)):
+            if rows[i]["iters"] == rows[i - 1]["iters"]:
+                continue  # group boundary only
+            above, below = rows[i:], rows[:i]
+            fa = sum(map(win, above)) / len(above)
+            fb = sum(map(win, below)) / len(below)
+            if win(above[0]) and fa >= 0.6 and fb < 0.4:
+                crossover = above[0]["iters"]
+                break
 
     deciles = []
     for d in range(10):
@@ -116,6 +154,7 @@ def main():
             continue
         deciles.append(
             {
+                "iters_range": [sl[0]["iters"], sl[-1]["iters"]],
                 "guesses_range": [sl[0]["guesses"], sl[-1]["guesses"]],
                 "bucket_ms_p50": round(
                     float(np.median([r["bucket_ms"] for r in sl])), 2
@@ -135,8 +174,9 @@ def main():
                 "states_per_device": STATES,
                 "boards": len(rows),
                 "race_wins_total": len(wins),
-                "crossover_guesses": crossover,
+                "crossover_iters": crossover,
                 "deciles": deciles,
+                "rows": rows,
             },
             indent=2,
         )

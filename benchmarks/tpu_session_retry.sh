@@ -1,0 +1,17 @@
+#!/bin/bash
+# Patient single-client TPU probe loop (claim discipline, docs/OPERATIONS.md):
+# each attempt is ONE process that either completes the measurement session
+# or dies by its own error — never killed externally. 15 min between
+# attempts so a sick terminal isn't hammered with claim requests.
+cd /root/repo
+for i in $(seq 1 40); do
+  echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> benchmarks/tpu_session_r3.log
+  python benchmarks/tpu_session.py >> benchmarks/tpu_session_r3.log 2>&1
+  rc=$?
+  echo "=== attempt $i exited rc=$rc $(date -u +%H:%M:%S) ===" >> benchmarks/tpu_session_r3.log
+  if grep -q '"phase": "done"' benchmarks/tpu_session_r3.jsonl 2>/dev/null; then
+    echo "=== session complete ===" >> benchmarks/tpu_session_r3.log
+    exit 0
+  fi
+  sleep 900
+done

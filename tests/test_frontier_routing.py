@@ -111,3 +111,18 @@ def test_worker_cell_tasks_never_probe_or_race(readme_puzzle):
     solution, info = eng.solve_one(readme_puzzle, frontier=False)
     assert oracle_is_valid_solution(solution)
     assert race_calls == [] and quick_calls == []
+
+
+def test_cli_routing_flags_parse_and_default():
+    from sudoku_solver_distributed_tpu.net.cli import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["-p", "8001", "-s", "7001", "--frontier", "8"])
+    assert args.frontier_route == "auto"
+    assert args.frontier_escalate_iters == 512
+    args = p.parse_args(
+        ["--frontier", "8", "--frontier-route", "always",
+         "--frontier-escalate-iters", "64"]
+    )
+    assert args.frontier_route == "always"
+    assert args.frontier_escalate_iters == 64
