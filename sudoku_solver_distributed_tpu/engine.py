@@ -128,14 +128,19 @@ class SolverEngine:
         # Per-request routing between the two single-board serving paths
         # (VERDICT r3 task 3). "always": every auto solve_one rides the
         # race — the pre-r3 global-flag behavior. "auto": a bucket-path
-        # probe at ``frontier_escalate_iters`` answers the easy mass (its
-        # p99+ on real corpora — see benchmarks/exp_frontier_crossover.py
-        # for the measured distribution), and only boards still RUNNING at
-        # that budget — the deep-search tail the race exists for — escalate
-        # to the frontier. The race must beat the bucket path somewhere to
-        # be more than decoration (the reference's distributed path vs its
-        # local one, reference node.py:427-475); auto routing sends it
-        # exactly that somewhere.
+        # probe at ``frontier_escalate_iters`` answers the easy mass, and
+        # only boards still RUNNING at that budget — the deep-search tail
+        # the race exists for — escalate to the frontier. Measured
+        # (benchmarks/exp_frontier_crossover.py, xo_cpu_r3.json): ordinary
+        # hard boards finish within ~110 iterations and the race loses on
+        # them; adversarially mined deep boards (benchmarks/mine_deep.py)
+        # run >=3039 and the race wins 85%+ of them at 25-35% lower
+        # latency even with ONE device's 64 speculative states — the
+        # single-chip case. The 512 default sits in the measured gap. The
+        # race must beat the bucket path somewhere to be more than
+        # decoration (the reference's distributed path vs its local one,
+        # reference node.py:427-475); auto routing sends it exactly that
+        # somewhere.
         self.frontier_route = frontier_route
         self.frontier_escalate_iters = frontier_escalate_iters
         self.backend = backend

@@ -126,3 +126,21 @@ def test_cli_routing_flags_parse_and_default():
     )
     assert args.frontier_route == "always"
     assert args.frontier_escalate_iters == 64
+
+
+def test_deep_mined_board_escalates_under_default_budget():
+    """The committed deep corpus (benchmarks/mine_deep.py: 525+ bucket-path
+    guesses, >=3039 lockstep iterations) must escalate under the DEFAULT
+    512-iteration probe — the measured crossover (xo_cpu_r3.json) says
+    these are exactly the boards the race wins."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    deep = np.load(
+        os.path.join(repo, "benchmarks", "corpus_9x9_deep_128.npz")
+    )["boards"]
+    eng, race_calls = _spy_engine()  # defaults: auto, 512
+    solution, info = eng.solve_one(deep[0].tolist())
+    assert oracle_is_valid_solution(solution)
+    assert info["frontier"] is True
+    assert len(race_calls) == 1 and eng.frontier_escalations == 1
