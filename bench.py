@@ -114,18 +114,42 @@ def main():
     # only (…_ONCE, a flag file marks attempts) or on every attempt
     # (…_ALWAYS). tests/test_bench_modes.py exercises the retry loop with
     # these; a real hang can't be staged without wedging the actual claim.
-    hang_flag = os.environ.get("BENCH_FAKE_INIT_HANG_ONCE")
-    if hang_flag and not os.path.exists(hang_flag):
-        open(hang_flag, "w").close()
-        time.sleep(init_timeout * 100)  # parked until the watchdog fires
-    if os.environ.get("BENCH_FAKE_INIT_HANG_ALWAYS") == "1":
-        time.sleep(init_timeout * 100)
+    # Neither fires in the CPU-fallback child: the hang being simulated IS
+    # accelerator claim acquisition, which the CPU backend never does.
+    in_fallback = bool(os.environ.get("BENCH_FALLBACK_REASON"))
+    if not in_fallback:
+        hang_flag = os.environ.get("BENCH_FAKE_INIT_HANG_ONCE")
+        if hang_flag and not os.path.exists(hang_flag):
+            open(hang_flag, "w").close()
+            time.sleep(init_timeout * 100)  # parked until the watchdog fires
+        if os.environ.get("BENCH_FAKE_INIT_HANG_ALWAYS") == "1":
+            time.sleep(init_timeout * 100)
+    elif os.environ.get("BENCH_FAKE_FALLBACK_FAIL") == "1":
+        sys.exit(9)  # test hook: drive the parent's last-resort JSON line
 
     # touch the backend FIRST so the watchdog window covers exactly the
     # claim acquisition — corpus generation below is host-side work that
-    # can legitimately take long on a first uncached run
-    n_chips = max(1, len(jax.devices()))
+    # can legitimately take long on a first uncached run. A backend that
+    # RAISES (e.g. "UNAVAILABLE: TPU backend setup/compile error" from a
+    # sick pooled terminal — the round-3 failure mode) is the same claim
+    # failure as a hang: exit rc=3 so the parent retries / falls back
+    # instead of dying with no JSON on stdout.
+    try:
+        n_chips = max(1, len(jax.devices()))
+    except RuntimeError as e:
+        print(
+            f"# FATAL: accelerator backend init raised: {e!r:.500} — "
+            f"pooled-chip claim unavailable (docs/OPERATIONS.md)",
+            file=sys.stderr,
+            flush=True,
+        )
+        sys.exit(3)
     init_done.set()  # backend is up; disarm the claim watchdog
+
+    if in_fallback and os.environ.get("BENCH_FAKE_FALLBACK_HANG") == "1":
+        # test hook: a post-init stall (the real slow-fallback shape, e.g.
+        # uncached corpus regeneration) — drives the parent's reserve timeout
+        time.sleep(3600)
 
     import jax.numpy as jnp
     import numpy as np
@@ -169,18 +193,23 @@ def main():
     best = min(times)
     pps_per_chip = BENCH_BATCH / min(best, sustained) / n_chips
 
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"puzzles_per_sec_per_chip_hard{BENCH_SIZE}x{BENCH_SIZE}"
-                ),
-                "value": round(pps_per_chip, 1),
-                "unit": "puzzles/s/chip",
-                "vs_baseline": round(pps_per_chip / TARGET_PER_CHIP, 4),
-            }
-        )
-    )
+    metric = f"puzzles_per_sec_per_chip_hard{BENCH_SIZE}x{BENCH_SIZE}"
+    record = {
+        "metric": metric,
+        "value": round(pps_per_chip, 1),
+        "unit": "puzzles/s/chip",
+        "vs_baseline": round(pps_per_chip / TARGET_PER_CHIP, 4),
+    }
+    # Labeled CPU fallback (VERDICT r3 task 1b): when the pooled-chip claim
+    # never frees, the parent re-runs this child on the CPU backend with the
+    # reason in the environment — the artifact then records an honest,
+    # clearly-tagged number instead of parsed:null.
+    fallback_reason = os.environ.get("BENCH_FALLBACK_REASON")
+    if fallback_reason:
+        record["metric"] = metric + "_cpu_fallback"
+        record["fallback_reason"] = fallback_reason
+        record["platform"] = jax.devices()[0].platform
+    print(json.dumps(record))
     print(
         f"# batch={BENCH_BATCH} repeats={BENCH_REPEATS} "
         f"sustained={sustained*1000:.1f}ms blocking_best={best*1000:.1f}ms "
@@ -507,54 +536,158 @@ def main_farm():
                 p.wait()
 
 
-def main_with_retry():
-    """Throughput mode wrapped in a bounded probe-and-retry loop.
+def _exit_code(rc: int) -> int:
+    """Map a signal-killed child's negative returncode to 128+signal so
+    pipeline callers never see it aliased into an unrelated 8-bit code
+    (e.g. -9 -> 247); positive codes pass through (ADVICE r3)."""
+    return 128 - rc if rc < 0 else rc
 
-    Backend init on the pooled/tunneled chip can hang on a stale pool-side
-    claim (docs/OPERATIONS.md); round 2's single 900 s give-up turned the
-    driver's only bench window into a failed artifact (BENCH_r02.json rc=3,
-    VERDICT r2 missing-item #1). Each attempt now runs in a child process
-    whose own init watchdog fails fast (rc=3), and the parent retries while
-    the total budget allows — a claim that frees mid-window still lands a
-    number. The child always exits by its OWN watchdog; the parent never
-    kills it (a mid-compile kill is what wedges the claim in the first
-    place — claim discipline, docs/OPERATIONS.md).
+
+def main_with_retry():
+    """Throughput mode wrapped in a bounded probe-retry-fallback loop.
+
+    Backend init on the pooled/tunneled chip can hang (stale pool-side
+    claim) or raise UNAVAILABLE (sick terminal) — docs/OPERATIONS.md. Each
+    attempt runs in a child process whose own init watchdog fails fast
+    (rc=3; the child always exits by its OWN watchdog, never an external
+    kill — a mid-compile kill is what wedges the claim in the first place).
+
+    Round 3 showed the remaining hole (BENCH_r03.json: rc=124,
+    parsed:null): the retry loop kept burning attempts until the DRIVER's
+    outer timeout SIGKILLed it mid-attempt, leaving no JSON line at all.
+    So the parent now (a) sizes its default total budget to finish well
+    inside a ~30 min driver window, and (b) when the budget no longer fits
+    another TPU attempt, runs one final child on the CPU backend (measured
+    ~25 s for the 4096-board corpus) so the artifact ALWAYS carries a
+    parseable, clearly-labeled record — a claim that never frees produces
+    `*_cpu_fallback` + the failure reason instead of parsed:null
+    (VERDICT r3 task 1).
     """
     import subprocess
 
-    total = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "2700"))
+    total = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "1500"))
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "420"))
     backoff = float(os.environ.get("BENCH_RETRY_BACKOFF_S", "45"))
+    # wall reserved for the CPU-fallback child (compile + solve + slack)
+    fallback_reserve = float(os.environ.get("BENCH_FALLBACK_RESERVE_S", "150"))
     deadline = time.time() + total
     env = dict(
         os.environ,
         BENCH_CHILD="1",
         BENCH_INIT_TIMEOUT_S=str(init_timeout),
     )
+    def run_child(child_env, timeout=None):
+        """Run one bench child, forwarding its streams; returns (rc, stdout).
+
+        Stdout is captured and re-printed so the parent KNOWS whether the
+        child landed its JSON line — a child that dies post-init (assert,
+        OOM kill) with no JSON must route to the fallback, not propagate a
+        bare nonzero exit with an empty stdout (the parsed:null shape this
+        wrapper exists to prevent). On timeout the child is killed (only
+        used for the CPU fallback child, which holds no pooled claim)."""
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=child_env,
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            return -9, ""
+        if proc.stdout:
+            print(proc.stdout, end="", flush=True)
+        if proc.stderr:
+            print(proc.stderr, end="", file=sys.stderr, flush=True)
+        return proc.returncode, proc.stdout
+
     attempt = 0
+    reason = None
     while True:
         attempt += 1
         t0 = time.time()
-        rc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env
-        ).returncode
+        rc, out = run_child(env)
+        if rc == 0:
+            if any(ln.startswith("{") for ln in out.splitlines()):
+                sys.exit(0)  # the number landed
+            reason = "bench child exited 0 without emitting a JSON line"
+            break
         if rc != 3:
-            sys.exit(rc)  # success, or a real (non-claim) failure
+            reason = (
+                f"bench child failed post-init rc={_exit_code(rc)} "
+                f"(claim acquisition succeeded or was skipped)"
+            )
+            break
         left = deadline - time.time()
         print(
-            f"# attempt {attempt} hit the init watchdog after "
+            f"# attempt {attempt} failed claim acquisition after "
             f"{time.time() - t0:.0f}s; budget left {left:.0f}s",
             file=sys.stderr,
             flush=True,
         )
-        if left < init_timeout + backoff:
-            print(
-                "# claim never freed within BENCH_TOTAL_BUDGET_S — giving up",
-                file=sys.stderr,
-                flush=True,
+        if left < init_timeout + backoff + fallback_reserve:
+            reason = (
+                f"pooled-chip claim never freed: {attempt} init attempts of "
+                f"{init_timeout:.0f}s each within BENCH_TOTAL_BUDGET_S="
+                f"{total:.0f}s (docs/OPERATIONS.md claim discipline)"
             )
-            sys.exit(3)
+            break
         time.sleep(backoff)
+
+    print(
+        "# falling back to the CPU backend so the artifact stays "
+        "machine-readable",
+        file=sys.stderr,
+        flush=True,
+    )
+    # Fallback batch: the committed-corpus size for this board size, unless
+    # the caller's (smaller) BENCH_BATCH also has a committed corpus — a
+    # batch with NO cached corpus would regenerate unique-solution puzzles
+    # on CPU, which can blow through the reserve (code-review r4).
+    fb_batch = {9: 4096, 16: 2048, 25: 512}[BENCH_SIZE]
+    if BENCH_BATCH < fb_batch and os.path.exists(CORPUS_PATH):
+        fb_batch = BENCH_BATCH
+    fb_env = dict(
+        env,
+        BENCH_PLATFORM="cpu",
+        BENCH_FALLBACK_REASON=reason,
+        BENCH_BATCH=str(fb_batch),
+        BENCH_REPEATS="3",
+    )
+    # The reserve bounds the WHOLE fallback child, or a slow CPU run would
+    # reproduce the driver-SIGKILL/parsed:null failure this path exists to
+    # prevent. A timeout kill is safe here: the CPU child holds no pooled
+    # claim to wedge (docs/OPERATIONS.md discipline applies to accelerator
+    # clients only).
+    rc, out = run_child(fb_env, timeout=fallback_reserve)
+    if rc == -9:
+        print("# CPU fallback child exceeded its reserve", file=sys.stderr)
+    if rc == 0 and not any(ln.startswith("{") for ln in out.splitlines()):
+        # same contract check as the primary child: exit 0 without a JSON
+        # line must still produce the last-resort record (code-review r4)
+        rc = 1
+    if rc != 0:
+        # last resort: the parent itself emits the one JSON line — the
+        # artifact contract ("every round records something parseable")
+        # survives even a broken CPU backend
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"puzzles_per_sec_per_chip_hard{BENCH_SIZE}x"
+                        f"{BENCH_SIZE}_unmeasured"
+                    ),
+                    "value": 0.0,
+                    "unit": "puzzles/s/chip",
+                    "vs_baseline": 0.0,
+                    "fallback_reason": (
+                        f"{reason}; CPU fallback child also failed "
+                        f"rc={_exit_code(rc)}"
+                    ),
+                }
+            )
+        )
+    sys.exit(0)
 
 
 if __name__ == "__main__":

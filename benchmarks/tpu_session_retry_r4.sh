@@ -1,0 +1,25 @@
+#!/bin/bash
+# Patient single-client TPU probe loop, round 4 (claim discipline,
+# docs/OPERATIONS.md): each attempt is ONE process that either completes the
+# measurement session or dies by its own error — never killed externally.
+# 15 min between attempts so a sick terminal isn't hammered with claims.
+#
+# Exits when the session reports "session complete" (all phases measured) or
+# the stop flag / STOP_AT deadline inside tpu_session.py fires. The round-3
+# wrapper may still be running; tpu_session.py's flock makes the overlap
+# harmless (the loser skips its attempt). To relaunch after a manual stop,
+# remove benchmarks/tpu_stop AND the trailing done markers in
+# benchmarks/tpu_session_r4.jsonl (the grep below would otherwise exit
+# immediately on the stale marker).
+cd /root/repo
+for i in $(seq 1 40); do
+  echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> benchmarks/tpu_session_r4.log
+  python benchmarks/tpu_session.py >> benchmarks/tpu_session_r4.log 2>&1
+  rc=$?
+  echo "=== attempt $i exited rc=$rc $(date -u +%H:%M:%S) ===" >> benchmarks/tpu_session_r4.log
+  if grep -q '"phase": "done"' benchmarks/tpu_session_r4.jsonl 2>/dev/null; then
+    echo "=== session finished (done marker) ===" >> benchmarks/tpu_session_r4.log
+    exit 0
+  fi
+  sleep 900
+done
