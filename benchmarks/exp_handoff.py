@@ -1,0 +1,138 @@
+"""Measure probe→race handoff vs restart-from-root (VERDICT r3 task 6).
+
+Under ``frontier_route="auto"`` an escalated board used to pay twice: the
+512-iteration probe, then a race that restarted from the ROOT (re-paying
+propagation + seeding). The handoff path (engine.frontier_handoff,
+parallel/frontier.state_handoff_frontier) seeds the race from the probe's
+unexplored subtrees instead. This experiment measures both END-TO-END
+``solve_one`` paths on the deep corpus — what an escalated /solve actually
+pays — plus the ordinary-hard control slice (which never escalates, so both
+paths must tie there).
+
+Output: per-class p50/p95 of both paths + the win rate, appended as one
+JSON line to ``benchmarks/handoff_cpu_r4.json``. The serving default
+(``SolverEngine(frontier_handoff=...)``) cites this artifact.
+
+Platform note: the virtual CPU mesh serializes shards on one core, so BOTH
+race paths are pessimistic vs real hardware equally; the handoff-vs-root
+DELTA is the probe's device time + seeding work, which the CPU measurement
+captures. benchmarks/tpu_session.py phase 2b carries the on-chip version.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python benchmarks/exp_handoff.py
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REPS = int(os.environ.get("HO_REPS", "3"))
+N_DEEP = int(os.environ.get("HO_DEEP", "48"))
+N_CONTROL = int(os.environ.get("HO_CONTROL", "16"))
+
+
+def main():
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("HO_PLATFORM", "cpu")
+    )
+
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.models import oracle_solve
+    from sudoku_solver_distributed_tpu.parallel import default_mesh
+
+    deep_path = os.path.join(REPO, "benchmarks", "corpus_9x9_deep_union.npz")
+    if not os.path.exists(deep_path):
+        deep_path = os.path.join(REPO, "benchmarks", "corpus_9x9_deep_128.npz")
+    deep = np.load(deep_path)["boards"][:N_DEEP]
+    hard = np.load(
+        os.path.join(REPO, "benchmarks", "corpus_9x9_hard_4096.npz")
+    )["boards"][:N_CONTROL]
+
+    mesh = default_mesh()
+    engines = {}
+    for handoff in (True, False):
+        eng = SolverEngine(
+            buckets=(1,),
+            frontier_mesh=mesh,
+            frontier_states_per_device=64,
+            frontier_handoff=handoff,
+        )
+        eng.warmup()
+        engines[handoff] = eng
+    # warm both escalation paths end-to-end (racer rungs the deep corpus hits)
+    for handoff, eng in engines.items():
+        eng.solve_one(deep[0])
+
+    def run_class(boards, verify=False):
+        rows = []
+        for board in boards:
+            times = {}
+            sols = {}
+            for handoff, eng in engines.items():
+                best = float("inf")
+                for _ in range(REPS):
+                    t0 = time.perf_counter()
+                    sol, info = eng.solve_one(board)
+                    best = min(best, (time.perf_counter() - t0) * 1e3)
+                times[handoff] = best
+                sols[handoff] = sol
+            row = {
+                "handoff_ms": round(times[True], 2),
+                "root_ms": round(times[False], 2),
+                "agree": (sols[True] is None) == (sols[False] is None),
+            }
+            if verify and sols[True] is not None:
+                row["oracle_ok"] = sols[True] == oracle_solve(
+                    np.asarray(board).tolist()
+                )
+            rows.append(row)
+        return rows
+
+    deep_rows = run_class(deep, verify=True)
+    ctl_rows = run_class(hard)
+
+    def summarize(rows):
+        h = np.asarray([r["handoff_ms"] for r in rows])
+        r = np.asarray([r["root_ms"] for r in rows])
+        return {
+            "n": len(rows),
+            "handoff_p50_ms": round(float(np.percentile(h, 50)), 2),
+            "root_p50_ms": round(float(np.percentile(r, 50)), 2),
+            "handoff_p95_ms": round(float(np.percentile(h, 95)), 2),
+            "root_p95_ms": round(float(np.percentile(r, 95)), 2),
+            "handoff_wins": int((h < r).sum()),
+            "speedup_p50": round(
+                float(np.percentile(r, 50) / np.percentile(h, 50)), 3
+            ),
+        }
+
+    record = {
+        "experiment": "probe_handoff_vs_root_restart",
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "corpus": os.path.basename(deep_path),
+        "reps": REPS,
+        "deep": summarize(deep_rows),
+        "control_hard": summarize(ctl_rows),
+        "all_verdicts_agree": all(
+            r["agree"] for r in deep_rows + ctl_rows
+        ),
+        "oracle_ok": all(r.get("oracle_ok", True) for r in deep_rows),
+        "t": round(time.time(), 1),
+    }
+    out = os.path.join(REPO, "benchmarks", "handoff_cpu_r4.json")
+    with open(out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Union the deep-board corpora into ``corpus_9x9_deep_union.npz``.
+
+VERDICT r3 task 5: the routing boundary must rest on more than one mining
+run. This merges every ``corpus_9x9_deep*.npz`` (the round-3 hill-climb,
+the round-4 second-seed hill-climb, the round-4 annealing miner), dedups,
+re-scores everything under the EXACT probe configuration (serving config,
+waves=1) so the classes are comparable, and keeps the deepest KEEP boards.
+
+The union corpus is what ``exp_frontier_crossover.py`` and
+``tpu_session.py`` phase 2 consume when present.
+
+Run on CPU: ``python benchmarks/merge_deep.py``.
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+KEEP = int(os.environ.get("MERGE_KEEP", "256"))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.ops import (
+        SPEC_9,
+        serving_config,
+        solve_batch,
+    )
+
+    sources = sorted(
+        p
+        for p in glob.glob(os.path.join(REPO, "benchmarks", "corpus_9x9_deep*.npz"))
+        if "union" not in os.path.basename(p)
+    )
+    boards = []
+    seen = set()
+    per_source = {}
+    for p in sources:
+        arr = np.load(p)["boards"].astype(np.int32)
+        fresh = 0
+        for b in arr:
+            key = b.tobytes()
+            if key in seen:
+                continue
+            seen.add(key)
+            boards.append(b)
+            fresh += 1
+        per_source[os.path.basename(p)] = {"boards": len(arr), "fresh": fresh}
+    boards = np.stack(boards)
+
+    cfg = dict(serving_config(9), waves=1)  # the probe's exact view
+    solve = jax.jit(lambda g: solve_batch(g, SPEC_9, **cfg))
+    M = len(boards)
+    P2 = 1 << max(0, M - 1).bit_length()
+    padded = (
+        np.concatenate([boards, np.zeros((P2 - M, 9, 9), np.int32)])
+        if P2 > M
+        else boards
+    )
+    res = jax.block_until_ready(solve(jnp.asarray(padded)))
+    sweeps = np.asarray(res.validations)[:M]
+    guesses = np.asarray(res.guesses)[:M]
+    assert bool(np.asarray(res.solved)[:M].all()), "deep corpora must solve"
+
+    order = np.argsort(-sweeps)[:KEEP]
+    out = os.path.join(REPO, "benchmarks", "corpus_9x9_deep_union.npz")
+    np.savez_compressed(
+        out,
+        boards=boards[order],
+        sweeps=sweeps[order],
+        guesses=guesses[order],
+    )
+    record = {
+        "sources": per_source,
+        "union_unique": M,
+        "kept": len(order),
+        "sweeps_max": int(sweeps[order][0]),
+        "sweeps_min_kept": int(sweeps[order][-1]),
+        "guesses_max": int(guesses[order].max()),
+        "corpus": os.path.basename(out),
+        "t": round(time.time(), 1),
+    }
+    with open(
+        os.path.join(REPO, "benchmarks", "merge_deep_r4.json"), "a"
+    ) as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,10 @@ BEAM = 48          # elites mutated each round
 MUTANTS = 12       # children per elite per round
 POOL = 384         # elite pool size between rounds
 SEED = int(os.environ.get("MINE_SEED", "20260731"))
+# Output filename tag: a second independent mining run (VERDICT r3 task 5)
+# must not overwrite the first run's corpus — distinct tags, then
+# benchmarks/merge_deep.py unions them for the crossover experiment.
+TAG = os.environ.get("MINE_TAG", "")
 
 
 def main():
@@ -123,7 +127,8 @@ def main():
 
     def save(tag=""):
         merged = sorted(best + pool, key=lambda t: -t[2])[:KEEP]
-        out = os.path.join(REPO, "benchmarks", f"corpus_9x9_deep_{KEEP}.npz")
+        name = f"corpus_9x9_deep_{TAG}_{KEEP}" if TAG else f"corpus_9x9_deep_{KEEP}"
+        out = os.path.join(REPO, "benchmarks", f"{name}.npz")
         np.savez_compressed(
             out,
             boards=np.stack([t[0] for t in merged]),

@@ -1,0 +1,209 @@
+"""Second-method deep-board miner: simulated annealing on the sweep count.
+
+VERDICT r3 task 5: the routing boundary (frontier_escalate_iters=512) rested
+on ONE hill-climb run's adversarial distribution (benchmarks/mine_deep.py,
+MINE_SEED=20260731). This miner is deliberately different on every axis that
+could bias that distribution:
+
+* **method** — per-chain simulated annealing (downhill moves accepted with
+  exp(Δ/T), geometric cooling, per-chain reheats), not a greedy elite beam;
+* **scorer** — per-board analysis-sweep count (``SolveResult.validations``,
+  ≈ the board's lockstep iterations — the unit the auto-route probe
+  observes), not the guess count;
+* **seeds** — fresh certified-unique minimal puzzles only (no shared
+  adversarial harvest), under an independent MINE_SEED.
+
+Mutations must preserve having-a-solution (clue removals only relax; added
+clues come from the chain's reference solution) and every accepted state
+carries a budgeted uniqueness certificate, like the first miner — those are
+correctness constraints, not search-strategy choices.
+
+Emits ``corpus_9x9_deep_anneal_{K}.npz`` (boards + guesses + sweeps).
+``benchmarks/merge_deep.py`` unions the two miners' corpora for the
+crossover experiment.
+
+Run on CPU (no TPU claim): ``python benchmarks/mine_deep_anneal.py``.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SECONDS = float(os.environ.get("MINE_SECONDS", "1800"))
+KEEP = int(os.environ.get("MINE_KEEP", "128"))
+CHAINS = 64            # independent annealing walkers, scored as one batch
+SEED = int(os.environ.get("MINE_SEED", "90210"))
+T0 = float(os.environ.get("MINE_T0", "40.0"))    # initial temperature (sweeps)
+COOL = float(os.environ.get("MINE_COOL", "0.995"))  # per-round geometric cooling
+REHEAT_ROUNDS = int(os.environ.get("MINE_REHEAT", "150"))  # stagnation reset
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+    from sudoku_solver_distributed_tpu.models.generator import _count, _solve
+    from sudoku_solver_distributed_tpu.ops import (
+        SPEC_9,
+        serving_config,
+        solve_batch,
+    )
+
+    rng = random.Random(SEED)
+    cfg = dict(serving_config(9), waves=1)  # the bucket-1/probe view
+    solve = jax.jit(lambda g: solve_batch(g, SPEC_9, **cfg))
+
+    def score(boards: np.ndarray):
+        """Per-board (sweeps, guesses); pow2-padded like the first miner."""
+        M = len(boards)
+        P2 = 1 << max(0, M - 1).bit_length()
+        if P2 > M:
+            boards = np.concatenate(
+                [boards, np.zeros((P2 - M, 9, 9), np.int32)]
+            )
+        res = jax.block_until_ready(solve(jnp.asarray(boards)))
+        return (
+            np.asarray(res.validations)[:M],
+            np.asarray(res.guesses)[:M],
+        )
+
+    def propose(board: np.ndarray, solution: np.ndarray) -> np.ndarray:
+        """One mutation preserving `solution` as a solution."""
+        child = board.copy()
+        filled = np.argwhere(child > 0)
+        holes = np.argwhere(child == 0)
+        op = rng.random()
+        k = rng.choice((1, 1, 1, 2, 2, 3))
+        if op < 0.5 and len(filled) > 17 + k:         # remove k clues
+            for idx in rng.sample(range(len(filled)), k):
+                i, j = filled[idx]
+                child[i, j] = 0
+        elif op < 0.95 and len(holes) and len(filled) > 17:  # swap
+            i, j = holes[rng.randrange(len(holes))]
+            child[i, j] = solution[i, j]
+            filled2 = np.argwhere(child > 0)
+            for idx in rng.sample(range(len(filled2)), min(k, len(filled2))):
+                fi, fj = filled2[idx]
+                child[fi, fj] = 0
+        elif len(holes):                              # add a clue
+            i, j = holes[rng.randrange(len(holes))]
+            child[i, j] = solution[i, j]
+        return child
+
+    def fresh_chains(n, tag):
+        boards = generate_batch(
+            n, 64, seed=SEED + 7717 * tag, unique=True
+        ).astype(np.int32)
+        sols = np.stack(
+            [np.asarray(_solve(b.tolist()), np.int32) for b in boards]
+        )
+        sweeps, guesses = score(boards)
+        return list(boards), list(sols), list(sweeps), list(guesses)
+
+    t_start = time.time()
+    cur_b, cur_s, cur_sw, cur_g = fresh_chains(CHAINS, 0)
+    best: dict = {}  # board-bytes -> (board, sweeps, guesses)
+    stagnant = [0] * CHAINS
+    T = [T0] * CHAINS
+    rounds = 0
+    reheats = 0
+
+    def bank(i):
+        key = cur_b[i].tobytes()
+        if key not in best or best[key][1] < cur_sw[i]:
+            best[key] = (cur_b[i].copy(), int(cur_sw[i]), int(cur_g[i]))
+
+    for i in range(CHAINS):
+        bank(i)
+
+    def save():
+        top = sorted(best.values(), key=lambda t: -t[1])[:KEEP]
+        out = os.path.join(
+            REPO, "benchmarks", f"corpus_9x9_deep_anneal_{KEEP}.npz"
+        )
+        np.savez_compressed(
+            out,
+            boards=np.stack([t[0] for t in top]),
+            sweeps=np.asarray([t[1] for t in top]),
+            guesses=np.asarray([t[2] for t in top]),
+        )
+        return out, top
+
+    while time.time() - t_start < SECONDS:
+        rounds += 1
+        proposals = []
+        valid = []
+        for i in range(CHAINS):
+            child = propose(cur_b[i], cur_s[i])
+            # budgeted uniqueness certificate; inconclusive → keep current
+            if _count(child.tolist(), limit=2) == 1:
+                proposals.append(child)
+                valid.append(i)
+            T[i] = max(T[i] * COOL, 0.5)
+        if not proposals:
+            continue
+        prop_sw, prop_g = score(np.stack(proposals))
+        for j, i in enumerate(valid):
+            delta = float(prop_sw[j]) - float(cur_sw[i])
+            if delta >= 0 or rng.random() < np.exp(delta / T[i]):
+                cur_b[i] = proposals[j]
+                cur_sw[i] = prop_sw[j]
+                cur_g[i] = prop_g[j]
+                if delta > 0:
+                    stagnant[i] = 0
+                    bank(i)
+                else:
+                    stagnant[i] += 1
+            else:
+                stagnant[i] += 1
+            if stagnant[i] >= REHEAT_ROUNDS:
+                # reheat: fresh board + full temperature — an independent
+                # chain restart, the annealing analog of the first miner's
+                # portfolio restarts
+                nb, ns, nsw, ng = fresh_chains(1, rounds * CHAINS + i)
+                cur_b[i], cur_s[i] = nb[0], ns[0]
+                cur_sw[i], cur_g[i] = nsw[0], ng[0]
+                T[i] = T0
+                stagnant[i] = 0
+                reheats += 1
+        if rounds % 50 == 0:
+            save()
+            top_sw = sorted((t[1] for t in best.values()), reverse=True)[:8]
+            print(
+                f"# round {rounds}: top sweeps {top_sw} "
+                f"(T p50 {sorted(T)[CHAINS // 2]:.1f}, reheats {reheats}, "
+                f"{time.time() - t_start:.0f}s)",
+                flush=True,
+            )
+
+    out, top = save()
+    print(
+        json.dumps(
+            {
+                "method": "simulated_annealing",
+                "scorer": "sweeps(validations)",
+                "rounds": rounds,
+                "reheats": reheats,
+                "kept": len(top),
+                "sweeps_max": int(top[0][1]),
+                "sweeps_min_kept": int(top[-1][1]),
+                "guesses_max": int(max(t[2] for t in top)),
+                "corpus": os.path.basename(out),
+                "elapsed_s": round(time.time() - t_start, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -22,8 +22,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ops import BoardSpec, SPEC_9, solve_batch
+from .ops import solver as _solver
 from .ops.config import SERVING_CONFIG
-from .ops.solver import RUNNING
+from .ops.solver import OVERFLOW, RUNNING, SOLVED
 from .utils.profiling import annotate, device_trace
 
 logger = logging.getLogger(__name__)
@@ -89,6 +90,7 @@ class SolverEngine:
         frontier_states_per_device: int = 64,
         frontier_route: str = "auto",
         frontier_escalate_iters: int = 512,
+        frontier_handoff: bool = False,
         backend: str = "xla",
         locked_candidates: Optional[bool] = None,
         waves: Optional[int] = None,
@@ -143,6 +145,21 @@ class SolverEngine:
         # somewhere.
         self.frontier_route = frontier_route
         self.frontier_escalate_iters = frontier_escalate_iters
+        # Probe→race state handoff (VERDICT r3 task 6): escalated requests
+        # seed the race from the probe's unexplored subtrees instead of the
+        # root, so the probe's iterations are not re-paid. MEASURED AND
+        # REJECTED as the default (benchmarks/exp_handoff.py,
+        # handoff_cpu_r4.json 2026-07-30: deep-corpus p50 86.0 ms handoff
+        # vs 73.4 ms root-restart, root wins 47/48, verdicts agree, oracle
+        # OK): the probe descends a single MRV chain, so its refuted
+        # region — the only thing a handoff saves — is tiny, while the
+        # stack-chain decomposition it hands over is far less balanced
+        # than a fresh MRV BFS split from the root. Off by default; kept
+        # as an opt-in (CLI --frontier-handoff) because the trade could
+        # flip where seeding RTTs dominate. Local-mesh race only either
+        # way: the multi-host serving loop's broadcast carries a bare
+        # board, and the followers never saw the leader's probe state.
+        self.frontier_handoff = frontier_handoff
         self.backend = backend
         if locked_candidates is None:
             locked_candidates = (
@@ -286,6 +303,53 @@ class SolverEngine:
             lambda grid: _run(grid, frontier_escalate_iters)
         )
 
+        # the handoff probe (frontier_handoff, xla backend only): the same
+        # short budget, but returning the full DFS state so an escalated
+        # board's race seeds from the probe's UNEXPLORED subtrees instead
+        # of restarting at the root (VERDICT r3 task 6 — the auto-route
+        # double-pay). Flat depth = the race's collapsed depth, so the
+        # handed-off stack decomposition matches what the race would
+        # guarantee (parallel/frontier.state_handoff_frontier).
+        depth_flat = self.max_depth
+        if isinstance(depth_flat, (tuple, list)):
+            depth_flat = max(depth_flat)
+
+        def _run_quick_state(grid):
+            st = _solver.init_state(grid, self.spec, depth_flat)
+
+            def cond(s):
+                return ((s.status == RUNNING).any()) & (
+                    s.iters < frontier_escalate_iters
+                )
+
+            st = jax.lax.while_loop(
+                cond,
+                lambda s: _solver.step(
+                    s,
+                    self.spec,
+                    self.locked_candidates,
+                    1,  # waves_eff for a single board (see _run)
+                    naked_pairs=self.naked_pairs,
+                ),
+                st,
+            )
+            st = _solver.finalize_status(st, self.spec)
+            # packed row for the common (probe-answers-it) path — ONE
+            # device→host transfer, the same serving contract as _run; the
+            # full state rides along untouched and is only fetched when
+            # the request escalates (code-review r4)
+            packed = jnp.concatenate(
+                [
+                    st.grid[0],
+                    st.status[:1],
+                    st.guesses[:1],
+                    st.validations[:1],
+                ]
+            )
+            return packed, st
+
+        self._solve_quick_state = jax.jit(_run_quick_state)
+
     @property
     def frontier_enabled(self) -> bool:
         """True when single-board solves route through the frontier race
@@ -304,6 +368,7 @@ class SolverEngine:
             "backend": self.backend,
             "frontier_enabled": self.frontier_enabled,
             "frontier_route": self.frontier_route,
+            "frontier_handoff": self.frontier_handoff,
             "frontier_fallbacks": self.frontier_fallbacks,
             "frontier_escalations": self.frontier_escalations,
         }
@@ -400,12 +465,25 @@ class SolverEngine:
                 self._solve(self._device_batch(np.zeros((b, N, N), np.int32)))
             )
         if self.frontier_enabled and self.frontier_route == "auto":
-            b1 = self._bucket_for(1)
-            jax.block_until_ready(
-                self._solve_quick(
-                    self._device_batch(np.zeros((b1, N, N), np.int32))
+            if (
+                self.frontier_handoff
+                and self.frontier_runner is None
+                and self.backend == "xla"
+            ):
+                # plain transfer, matching _probe_quick_state (no batch
+                # sharding for a 1-row probe array)
+                jax.block_until_ready(
+                    self._solve_quick_state(
+                        jnp.asarray(np.zeros((1, N, N), np.int32))
+                    )
                 )
-            )
+            else:
+                b1 = self._bucket_for(1)
+                jax.block_until_ready(
+                    self._solve_quick(
+                        self._device_batch(np.zeros((b1, N, N), np.int32))
+                    )
+                )
         if self.frontier_mesh is not None:
             # compile the frontier race for the bucket ladder requests hit
             # in practice (seeding overshoots by a data-dependent factor ≤ N,
@@ -485,7 +563,13 @@ class SolverEngine:
         row = packed[0]
         status = int(row[C + 1])
         validations = int(row[C + 3])
-        if status == RUNNING:
+        if status in (RUNNING, OVERFLOW):
+            # RUNNING: out of probe iterations — the deep-search tail the
+            # race exists for. OVERFLOW: the probe's guess stack overflowed,
+            # which is NOT an answer either (with a custom int max_depth
+            # shallower than the search needs, returning it as "no solution"
+            # would be wrong — ADVICE r3); the race runs the full-depth
+            # stack, so escalate both.
             with self._lock:
                 # bill the probe's sweeps; the race accounts its own
                 self.validations += validations
@@ -503,7 +587,52 @@ class SolverEngine:
         N = self.spec.size
         return (row[:C].reshape(N, N).tolist() if solved else None), info
 
-    def _frontier_raw(self, arr: np.ndarray):
+    def _probe_quick_state(self, arr: np.ndarray):
+        """Handoff variant of ``_probe_quick`` (frontier_handoff=True).
+
+        Returns ("done", (solution | None, info)) when the probe answered
+        the request, or ("escalate", seed_states) with the probe's
+        unexplored subtrees (parallel/frontier.state_handoff_frontier) for
+        the race to continue from — the probe's search effort is handed
+        off instead of re-paid (VERDICT r3 task 6)."""
+        # plain device transfer, NOT _device_batch: a batch-axis sharding
+        # can't place a 1-row array (K-way split of size 1), and _probe_quick
+        # handles that case by bucket padding — here the state must stay
+        # unpadded for the stack decomposition, so bypass the sharding (the
+        # probe is a single-board program either way; code-review r4)
+        packed_dev, st = self._solve_quick_state(jnp.asarray(arr[None]))
+        packed = np.asarray(packed_dev)  # ONE transfer on the common path
+        C = self.spec.cells
+        status = int(packed[C])
+        validations = int(packed[C + 2])
+        if status in (RUNNING, OVERFLOW):
+            # same escalation contract as _probe_quick: neither is an
+            # answer (OVERFLOW: see the staged-depth note there). Fetching
+            # the stack here is the rare deep path; the race that follows
+            # dominates the extra pulls.
+            from .parallel.frontier import state_handoff_frontier
+
+            seeds = state_handoff_frontier(jax.device_get(st), self.spec)
+            with self._lock:
+                self.validations += validations
+                self.frontier_escalations += 1
+            return "escalate", seeds
+        solved = status == SOLVED
+        with self._lock:
+            self.validations += validations
+            self.solved_puzzles += int(solved)
+        info = {
+            "validations": validations,
+            "guesses": int(packed[C + 1]),
+            "routed": "bucket-quick",
+        }
+        N = self.spec.size
+        solution = (
+            packed[:C].reshape(N, N).tolist() if solved else None
+        )
+        return "done", (solution, info)
+
+    def _frontier_raw(self, arr: np.ndarray, seed_states=None):
         """Run the race without serving-stats side effects; _frontier_solve
         wraps it with the counter accounting."""
         if self.frontier_runner is not None:
@@ -520,11 +649,12 @@ class SolverEngine:
                 locked=self.locked_candidates,
                 waves=self.waves,
                 naked_pairs=self.naked_pairs,
+                initial_states=seed_states,
             )
         return solution, dict(info, frontier=True)
 
-    def _frontier_solve(self, arr: np.ndarray):
-        solution, info = self._frontier_raw(arr)
+    def _frontier_solve(self, arr: np.ndarray, seed_states=None):
+        solution, info = self._frontier_raw(arr, seed_states)
         with self._lock:
             self.validations += info["validations"]
             if solution is not None:
@@ -600,6 +730,7 @@ class SolverEngine:
             if frontier is None
             else (frontier and self.frontier_enabled)
         )
+        seed_states = None
         if use_frontier and frontier is None and self.frontier_route == "auto":
             # measured routing policy (benchmarks/exp_frontier_crossover.py):
             # the quick bucket probe answers the easy mass in one short
@@ -607,12 +738,23 @@ class SolverEngine:
             # budget — where serial search time dwarfs the race's seeding
             # overhead — go to the frontier. An explicit frontier=True
             # bypasses the probe.
-            probed = self._probe_quick(arr)
-            if probed is not None:
-                return probed
+            use_handoff = (
+                self.frontier_handoff
+                and self.frontier_runner is None
+                and self.backend == "xla"
+            )
+            if use_handoff:
+                outcome, payload = self._probe_quick_state(arr)
+                if outcome == "done":
+                    return payload
+                seed_states = payload  # race continues the probe's search
+            else:
+                probed = self._probe_quick(arr)
+                if probed is not None:
+                    return probed
         if use_frontier:
             try:
-                return self._frontier_solve(arr)
+                return self._frontier_solve(arr, seed_states)
             except Exception:  # noqa: BLE001 — any race failure
                 # A dead/failed frontier path (e.g. a failed collective
                 # stopping the multi-host serving loop) must not take

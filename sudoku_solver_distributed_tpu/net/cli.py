@@ -119,6 +119,15 @@ def build_parser() -> argparse.ArgumentParser:
         "request escalates to the frontier race",
     )
     parser.add_argument(
+        "--frontier-handoff",
+        action="store_true",
+        help="seed escalated races from the auto-route probe's unexplored "
+        "subtrees instead of restarting from the board's root. Off by "
+        "default: measured slower (benchmarks/exp_handoff.py — the root "
+        "restart's fresh MRV split beats the probe's chain decomposition); "
+        "kept as an opt-in for deployments where seeding RTTs dominate",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         choices=["cpu", "tpu"],
@@ -170,6 +179,7 @@ def main(argv=None) -> None:
     if args.frontier > 0:
         kwargs["frontier_route"] = args.frontier_route
         kwargs["frontier_escalate_iters"] = args.frontier_escalate_iters
+        kwargs["frontier_handoff"] = args.frontier_handoff
     engine = SolverEngine(**kwargs)
     if args.frontier > 0 and multi_host:
         # The racer is a collective over the global mesh: every host enters

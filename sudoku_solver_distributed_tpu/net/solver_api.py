@@ -58,14 +58,25 @@ class SudokuSolver:
         return self._engine.validations
 
     def solve_sudoku(self, sudoku):
-        """Solve in place-ish: returns the solved board or None (reference
-        node.py:31-40)."""
+        """Solve; returns the solved board or None (reference node.py:31-40).
+
+        The reference solves by MUTATING the caller's nested lists; scripts
+        written against it read the solution out of the object they passed
+        in. When the input is a mutable nested-list board, the solved grid
+        is copied back into it so those scripts keep working (ADVICE r3);
+        immutable inputs (tuples, numpy arrays) just get the return value.
+        """
         self.sudoku_board = sudoku
         solution, _ = self._engine.solve_one(sudoku, frontier=False)
         if solution is None:
             return None
         self.sudoku_board = solution
         self.solved_puzzles += 1
+        if isinstance(sudoku, list) and all(
+            isinstance(r, list) for r in sudoku
+        ):
+            for row, solved_row in zip(sudoku, solution):
+                row[:] = solved_row
         return solution
 
     def is_valid_move(self, board, row: int, col: int, num: int) -> bool:

@@ -13,7 +13,7 @@ The reference's parallelism is a master/worker task farm over UDP peers
 
 from .mesh import default_mesh, data_sharding
 from .shard import make_sharded_solver
-from .frontier import frontier_solve, seed_frontier
+from .frontier import frontier_solve, seed_frontier, state_handoff_frontier
 from .serving_loop import FrontierServingLoop
 
 __all__ = [
@@ -22,5 +22,6 @@ __all__ = [
     "make_sharded_solver",
     "frontier_solve",
     "seed_frontier",
+    "state_handoff_frontier",
     "FrontierServingLoop",
 ]

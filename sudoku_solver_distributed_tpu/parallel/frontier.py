@@ -72,6 +72,52 @@ def _seed_device():
         return None
 
 
+def state_handoff_frontier(state, spec: BoardSpec) -> np.ndarray:
+    """Decompose a single-board DFS end state into its unexplored subtrees.
+
+    The probe→race handoff (VERDICT r3 task 6): instead of restarting an
+    escalated board from its root — re-paying the probe's propagation and
+    search — the race seeds from what the probe's search state says is LEFT.
+    For a depth-``d`` state the unexplored region of the root's solution
+    space is exactly:
+
+    * for each stack level ``k < d``: the pre-guess snapshot
+      ``stack_grid[k]`` with ``stack_cell[k]`` set to each still-untried
+      candidate in ``stack_mask[k]`` (ops/solver._step records exactly the
+      bits not yet tried there), and
+    * the current ``grid`` — the active path's subtree, still mid-search.
+
+    These boards are pairwise disjoint and, together with the regions the
+    probe already refuted, cover the root space — so the race's verdict
+    over them (plus the probe's refutations) is a verdict for the root.
+    The continuation board re-enters the race at stack depth 0, so a probe
+    that OVERFLOWed its stack hands the race a fresh full-depth budget.
+
+    Host-side and bucket-1 by design (the probe is a single board).
+    Returns (M, N, N) int32 with M ≥ 1.
+    """
+    N = spec.size
+    depth = int(np.asarray(state.depth)[0])
+    boards = []
+    stack_grid = np.asarray(state.stack_grid)[0].astype(np.int32)
+    stack_cell = np.asarray(state.stack_cell)[0]
+    stack_mask = np.asarray(state.stack_mask)[0]
+    for k in range(min(depth, stack_mask.shape[0])):
+        mask = int(stack_mask[k])
+        if mask == 0:
+            continue
+        i, j = divmod(int(stack_cell[k]), N)
+        base = stack_grid[k].reshape(N, N)
+        while mask:
+            bit = mask & -mask
+            mask &= ~bit
+            child = base.copy()
+            child[i, j] = bit.bit_length()
+            boards.append(child)
+    boards.append(np.asarray(state.grid)[0].reshape(N, N).astype(np.int32))
+    return np.stack(boards)
+
+
 def seed_frontier(
     board: np.ndarray,
     spec: BoardSpec = SPEC_9,
@@ -79,6 +125,7 @@ def seed_frontier(
     target: int = 64,
     max_rounds: Optional[int] = None,
     locked: bool = False,
+    initial_states: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Expand one board into ≥``target`` disjoint speculative states.
 
@@ -86,6 +133,11 @@ def seed_frontier(
     contradictions, then k-way split each state on its MRV cell (one child per
     candidate value — children partition the parent's solution space exactly).
     Stops early if propagation alone solves the board.
+
+    ``initial_states``: start the expansion from these (M, N, N) states
+    instead of the root board — the probe→race handoff path
+    (``state_handoff_frontier``). The states must jointly cover the
+    unexplored solution space for the race's verdict to be authoritative.
 
     Returns (states, solved): states is (M, N, N) with M ≥ target unless the
     search space is exhausted (then padded with instantly-unsat boards so the
@@ -95,7 +147,10 @@ def seed_frontier(
     if max_rounds is None:
         # each round either assigns singles (≤ cells of them) or splits
         max_rounds = spec.cells + 16
-    states = np.asarray(board, np.int32)[None]
+    if initial_states is not None:
+        states = np.asarray(initial_states, np.int32)
+    else:
+        states = np.asarray(board, np.int32)[None]
     analyze_j, assign_j = _seed_jits(spec, locked)
     seed_dev = _seed_device()
     ctx = (
@@ -309,6 +364,7 @@ def frontier_solve(
     locked: bool = False,
     waves: int = 1,
     naked_pairs: Optional[bool] = None,
+    initial_states: Optional[np.ndarray] = None,
 ) -> Tuple[Optional[list], dict]:
     """Solve one (hard) board by racing its search subtrees across the mesh.
 
@@ -319,15 +375,27 @@ def frontier_solve(
     and collapses to its deepest stage inside ``_make_racer`` (the race
     runs one flat loop per subtree, so only the full-depth guarantee is
     meaningful).
+
+    ``initial_states``: seed the race from these states instead of
+    expanding ``board`` from its root (probe→race handoff,
+    ``state_handoff_frontier``); "not found" then means "not in THESE
+    subtrees", so callers must pass a covering set of the unexplored space.
     """
     mesh = mesh if mesh is not None else default_mesh()
     n_dev = mesh.devices.size
     target = n_dev * states_per_device
 
     board = np.asarray(board, np.int32)
-    states, early = seed_frontier(board, spec, target=target, locked=locked)
+    states, early = seed_frontier(
+        board, spec, target=target, locked=locked,
+        initial_states=initial_states,
+    )
     if early is not None:
-        return early.tolist(), {"validations": 0, "seeded": len(states)}
+        return early.tolist(), {
+            "validations": 0,
+            "seeded": len(states),
+            "handoff": initial_states is not None,
+        }
 
     # Never drop a seeded state — each covers a disjoint slice of the search
     # space, so dropping one could lose the only solution. Round the count up
@@ -368,7 +436,11 @@ def frontier_solve(
         packed = np.asarray(racer(jnp.asarray(states)))
     C = spec.cells
     found, validations = bool(packed[C]), int(packed[C + 1])
-    info = {"validations": validations, "seeded": len(states)}
+    info = {
+        "validations": validations,
+        "seeded": len(states),
+        "handoff": initial_states is not None,
+    }
     if not found:
         return None, info
     return packed[:C].reshape(spec.size, spec.size).tolist(), info

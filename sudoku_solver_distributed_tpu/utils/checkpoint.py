@@ -55,11 +55,34 @@ def boards_fingerprint(boards: np.ndarray) -> np.ndarray:
     return np.frombuffer(digest, np.uint8)
 
 
+def config_blob(
+    locked: bool, waves: int, naked_pairs, max_depth
+) -> np.ndarray:
+    """Canonical encoding of the solver knobs that shape the search
+    trajectory. Stored in the snapshot so a resume under a DIFFERENT
+    configuration — which would silently continue a different search and
+    void the bit-for-bit guarantee — is refused like a board mismatch
+    (ADVICE r3)."""
+    import json
+
+    blob = json.dumps(
+        {
+            "locked": bool(locked),
+            "waves": int(waves),
+            "naked_pairs": None if naked_pairs is None else bool(naked_pairs),
+            "max_depth": None if max_depth is None else int(max_depth),
+        },
+        sort_keys=True,
+    ).encode()
+    return np.frombuffer(blob, np.uint8)
+
+
 def save_solver_state(
     path: str,
     state: S._State,
     spec: BoardSpec,
     boards_hash: Optional[np.ndarray] = None,
+    config: Optional[np.ndarray] = None,
 ) -> None:
     """Atomically snapshot a solver state pytree to ``path`` (.npz)."""
     arrays = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
@@ -67,6 +90,8 @@ def save_solver_state(
     arrays["__box__"] = np.int64(spec.box)
     if boards_hash is not None:
         arrays["__boards_sha256__"] = np.asarray(boards_hash, np.uint8)
+    if config is not None:
+        arrays["__config_json__"] = np.asarray(config, np.uint8)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -82,11 +107,12 @@ def save_solver_state(
 
 def load_solver_state(
     path: str,
-) -> Tuple[S._State, BoardSpec, Optional[np.ndarray]]:
+) -> Tuple[S._State, BoardSpec, Optional[np.ndarray], Optional[np.ndarray]]:
     """Restore a snapshot written by ``save_solver_state``.
 
-    Returns (state, spec, boards_hash) — boards_hash is None for snapshots
-    saved without one."""
+    Returns (state, spec, boards_hash, config) — boards_hash/config are
+    None for snapshots saved without them (pre-r4 snapshots carry no
+    config blob and resume under the caller's configuration unchecked)."""
     with np.load(path) as z:
         if int(z["__format__"]) != _FORMAT:
             raise ValueError(
@@ -99,6 +125,11 @@ def load_solver_state(
             if "__boards_sha256__" in z
             else None
         )
+        config = (
+            np.asarray(z["__config_json__"])
+            if "__config_json__" in z
+            else None
+        )
     C = spec.cells
     if state.grid.ndim != 2 or state.grid.shape[1] != C:
         raise ValueError(
@@ -109,6 +140,7 @@ def load_solver_state(
         jax.tree.map(lambda x: jax.numpy.asarray(x), state),
         spec,
         boards_hash,
+        config,
     )
 
 
@@ -172,9 +204,10 @@ def solve_batch_resumable(
         # parallel/frontier.py)
         max_depth = max(max_depth)
     fingerprint = boards_fingerprint(grid)
+    cfg_blob = config_blob(locked, waves, naked_pairs, max_depth)
 
     if os.path.exists(checkpoint_path):
-        state, ck_spec, ck_hash = load_solver_state(checkpoint_path)
+        state, ck_spec, ck_hash, ck_cfg = load_solver_state(checkpoint_path)
         if ck_spec != spec:
             raise ValueError(
                 f"checkpoint at {checkpoint_path} is for a "
@@ -190,6 +223,15 @@ def solve_batch_resumable(
                 f"checkpoint at {checkpoint_path} belongs to a different "
                 f"board batch — refusing to resume (delete the stale "
                 f"snapshot or use a distinct path per batch)"
+            )
+        if ck_cfg is not None and not np.array_equal(ck_cfg, cfg_blob):
+            raise ValueError(
+                f"checkpoint at {checkpoint_path} was written under solver "
+                f"configuration {bytes(ck_cfg).decode()} but this resume "
+                f"requests {bytes(cfg_blob).decode()} — refusing: resuming "
+                f"under a different configuration would continue a "
+                f"DIFFERENT search trajectory and void the bit-for-bit "
+                f"guarantee (ADVICE r3)"
             )
     else:
         state = S.init_state(jax.numpy.asarray(grid), spec, max_depth)
@@ -218,7 +260,9 @@ def solve_batch_resumable(
         done = not bool(np.asarray(state.status == S.RUNNING).any())
         if done:
             break
-        save_solver_state(checkpoint_path, state, spec, fingerprint)
+        save_solver_state(
+            checkpoint_path, state, spec, fingerprint, config=cfg_blob
+        )
         if int(state.iters) >= max_iters:
             # budget exhausted with boards still RUNNING: the snapshot just
             # written is the resume point — a re-run with a larger

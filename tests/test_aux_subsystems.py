@@ -72,9 +72,10 @@ def test_checkpoint_roundtrip_and_validation(tmp_path):
     state = S.init_state(jnp.asarray(boards), SPEC_9, 16)
     path = str(tmp_path / "state.npz")
     save_solver_state(path, state, SPEC_9)
-    loaded, spec, boards_hash = load_solver_state(path)
+    loaded, spec, boards_hash, config = load_solver_state(path)
     assert spec == SPEC_9
     assert boards_hash is None  # save without a fingerprint stays loadable
+    assert config is None  # pre-r4 snapshots carry no config blob
     for f in state._fields:
         np.testing.assert_array_equal(
             np.asarray(getattr(state, f)), np.asarray(getattr(loaded, f))
@@ -91,6 +92,30 @@ def test_checkpoint_roundtrip_and_validation(tmp_path):
         solve_batch_resumable(
             generate_batch(5, 30, seed=1), SPEC_9, checkpoint_path=path
         )
+
+
+def test_checkpoint_refuses_config_mismatch(tmp_path):
+    """ADVICE r3: a snapshot resumed under different solver knobs would
+    silently continue a DIFFERENT search trajectory — it must be refused
+    like a board mismatch, and the error must name both configurations."""
+    boards = generate_batch(8, 56, seed=47, unique=True)
+    ck = str(tmp_path / "cfg.npz")
+    # interrupted run under waves=1: the tiny chunk budget guarantees at
+    # least one snapshot before max_iters
+    res = solve_batch_resumable(
+        boards, SPEC_9, checkpoint_path=ck, chunk_iters=4, max_iters=8,
+        keep_checkpoint=True, waves=1,
+    )
+    assert os.path.exists(ck), "test needs an unfinished snapshot"
+    with pytest.raises(ValueError, match="different configuration|waves"):
+        solve_batch_resumable(
+            boards, SPEC_9, checkpoint_path=ck, chunk_iters=4, waves=2,
+        )
+    # same configuration resumes fine and completes
+    res = solve_batch_resumable(
+        boards, SPEC_9, checkpoint_path=ck, chunk_iters=64, waves=1,
+    )
+    assert bool(np.asarray(res.solved).all())
 
 
 # -- request metrics --------------------------------------------------------

@@ -32,8 +32,8 @@ def _spy_engine(**kw):
     calls = []
     orig = eng._frontier_solve
 
-    def spy(arr):
-        out = orig(arr)
+    def spy(arr, seed_states=None):
+        out = orig(arr, seed_states)
         calls.append(out[1])
         return out
 
@@ -73,6 +73,23 @@ def test_auto_route_unsat_answered_by_probe():
     assert race_calls == []
 
 
+def test_auto_route_probe_overflow_escalates(readme_puzzle):
+    """ADVICE r3: a probe whose guess stack OVERFLOWs has NOT answered the
+    request — with a custom max_depth shallower than the search needs it
+    must escalate to the race (whose per-subtree searches are shallower),
+    never return 'no solution'."""
+    # max_depth=1: the README board overflows a 1-deep stack immediately
+    eng, race_calls = _spy_engine(max_depth=1, frontier_escalate_iters=512)
+    solution, info = eng.solve_one(readme_puzzle)
+    assert eng.frontier_escalations == 1, "OVERFLOW probe must escalate"
+    assert len(race_calls) == 1
+    # the race decomposes the board into subtrees, each needing a shallower
+    # stack than the root search — depth 1 may still be too small for it to
+    # FINISH, but the probe must not have claimed "no solution" on its own
+    if solution is not None:
+        assert oracle_is_valid_solution(solution)
+
+
 def test_explicit_frontier_true_bypasses_probe(readme_puzzle):
     eng, race_calls = _spy_engine()
     solution, info = eng.solve_one(readme_puzzle, frontier=True)
@@ -108,9 +125,99 @@ def test_worker_cell_tasks_never_probe_or_race(readme_puzzle):
     quick_calls = []
     orig = eng._probe_quick
     eng._probe_quick = lambda arr: (quick_calls.append(1), orig(arr))[1]
+    orig_state = eng._probe_quick_state
+    eng._probe_quick_state = (
+        lambda arr: (quick_calls.append(1), orig_state(arr))[1]
+    )
     solution, info = eng.solve_one(readme_puzzle, frontier=False)
     assert oracle_is_valid_solution(solution)
     assert race_calls == [] and quick_calls == []
+
+
+def test_handoff_seeds_cover_the_solution(readme_puzzle):
+    """Soundness of the probe→race handoff (VERDICT r3 task 6): the
+    decomposed end-state subtrees must still contain the board's solution —
+    exactly one seed is a prefix of it (the seeds partition the unexplored
+    space, and the probe hasn't found the solution yet)."""
+    import jax.numpy as jnp
+
+    from sudoku_solver_distributed_tpu.models import oracle_solve
+    from sudoku_solver_distributed_tpu.parallel import state_handoff_frontier
+    from sudoku_solver_distributed_tpu.ops import SPEC_9
+
+    eng = SolverEngine(
+        buckets=(1,),
+        frontier_mesh=default_mesh(),
+        frontier_states_per_device=8,
+        frontier_escalate_iters=4,  # force a mid-search state
+    )
+    arr = np.asarray(readme_puzzle, np.int32)
+    _, st = eng._solve_quick_state(jnp.asarray(arr[None]))
+    assert int(np.asarray(st.status)[0]) == 0, "probe must still be RUNNING"
+    seeds = state_handoff_frontier(st, SPEC_9)
+    assert len(seeds) >= 1
+    solution = np.asarray(oracle_solve(readme_puzzle), np.int32)
+    compatible = [
+        s for s in seeds if bool(((s == 0) | (s == solution)).all())
+    ]
+    assert len(compatible) == 1, (
+        f"{len(compatible)} seeds are solution prefixes; the partition "
+        f"must contain the solution exactly once"
+    )
+    # every seed preserves the original clues (subtrees of THIS board)
+    for s in seeds:
+        assert bool((s[arr > 0] == arr[arr > 0]).all())
+
+
+def test_handoff_escalation_solves_and_tags_info(readme_puzzle):
+    eng, race_calls = _spy_engine(
+        frontier_escalate_iters=4, frontier_handoff=True
+    )
+    solution, info = eng.solve_one(readme_puzzle)
+    assert oracle_is_valid_solution(solution)
+    assert info["frontier"] is True
+    assert info.get("handoff") is True, "race must seed from the probe state"
+    assert eng.frontier_escalations == 1
+
+
+def test_handoff_off_by_default_root_seeding(readme_puzzle):
+    """The measured default (benchmarks/exp_handoff.py: root restart beats
+    the handoff decomposition 47/48 on the deep corpus): escalation re-seeds
+    from the root unless --frontier-handoff opts in."""
+    eng, race_calls = _spy_engine(frontier_escalate_iters=4)
+    assert eng.frontier_handoff is False
+    solution, info = eng.solve_one(readme_puzzle)
+    assert oracle_is_valid_solution(solution)
+    assert info["frontier"] is True
+    assert info.get("handoff") is False
+    assert eng.frontier_escalations == 1
+
+
+def test_handoff_escalation_matches_oracle_on_deep_corpus():
+    """Escalated deep boards (the real handoff traffic) must produce the
+    oracle's unique solution — losing a subtree in the handoff would show
+    up here as a wrong/missing solution."""
+    import os
+
+    from sudoku_solver_distributed_tpu.models import oracle_solve
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        "corpus_9x9_deep_128.npz",
+    )
+    if not os.path.exists(path):
+        pytest.skip("deep corpus not present")
+    boards = np.load(path)["boards"][:3]
+    eng, race_calls = _spy_engine(frontier_handoff=True)  # 512-iter budget
+    for board in boards:
+        solution, info = eng.solve_one(board)
+        assert info["frontier"] is True
+        assert info.get("handoff") is True
+        assert np.array_equal(
+            np.asarray(solution), np.asarray(oracle_solve(board.tolist()))
+        )
+    assert eng.frontier_escalations == len(boards)
 
 
 def test_cli_routing_flags_parse_and_default():
@@ -120,12 +227,14 @@ def test_cli_routing_flags_parse_and_default():
     args = p.parse_args(["-p", "8001", "-s", "7001", "--frontier", "8"])
     assert args.frontier_route == "auto"
     assert args.frontier_escalate_iters == 512
+    assert args.frontier_handoff is False  # root restart is the default
     args = p.parse_args(
         ["--frontier", "8", "--frontier-route", "always",
-         "--frontier-escalate-iters", "64"]
+         "--frontier-escalate-iters", "64", "--frontier-handoff"]
     )
     assert args.frontier_route == "always"
     assert args.frontier_escalate_iters == 64
+    assert args.frontier_handoff is True
 
 
 def test_deep_mined_board_escalates_under_default_budget():

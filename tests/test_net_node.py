@@ -267,8 +267,8 @@ def test_http_solve_frontier_path(readme_puzzle):
     calls = []
     orig = eng._frontier_solve
 
-    def spy(arr):
-        out = orig(arr)
+    def spy(arr, seed_states=None):
+        out = orig(arr, seed_states)
         calls.append(out[1])
         return out
 

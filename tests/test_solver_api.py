@@ -65,6 +65,33 @@ def test_sudoku_solver_class_surface():
     assert "|" in solver.__str__(sol)
 
 
+def test_solve_sudoku_mutates_caller_board_in_place():
+    """ADVICE r3: the reference's SudokuSolver.solve_sudoku solves by
+    mutating the passed nested lists (reference node.py:31-40); scripts
+    that read the solution out of the object they passed in must keep
+    working. Immutable inputs still just get the return value."""
+    from node import SudokuSolver
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+
+    solver = SudokuSolver(engine=SolverEngine(buckets=(1,)))
+    board = generate_batch(1, 40, seed=11, unique=True)[0]
+    caller_board = board.tolist()
+    sol = solver.solve_sudoku(caller_board)
+    assert sol is not None
+    assert caller_board == sol, "caller's nested lists must hold the solution"
+
+    # tuple-of-tuples input: no mutation possible, return value only
+    immutable = tuple(tuple(r) for r in board.tolist())
+    assert solver.solve_sudoku(immutable) is not None
+
+    # unsolvable: caller board untouched
+    bad = board.tolist()
+    bad[0][0] = bad[0][1] = 5
+    before = [row[:] for row in bad]
+    assert solver.solve_sudoku(bad) is None
+    assert bad == before
+
+
 def test_sudoku_solver_validations_counter():
     from node import SudokuSolver
     from sudoku_solver_distributed_tpu.engine import SolverEngine
