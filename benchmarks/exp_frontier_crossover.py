@@ -51,13 +51,18 @@ def main():
         frontier_solve,
     )
 
-    # deepest available adversarial corpus: the hill-climbed deep set
-    # (benchmarks/mine_deep.py) if mined, else the random-minimal harvest
-    adv_path = os.path.join(REPO, "benchmarks", "corpus_9x9_deep_128.npz")
-    if not os.path.exists(adv_path):
-        adv_path = os.path.join(
-            REPO, "benchmarks", "corpus_9x9_adversarial_128.npz"
-        )
+    # deepest available adversarial corpus, in preference order: the
+    # multi-run union (benchmarks/merge_deep.py — round 4, what makes the
+    # boundary more than one-seed-lucky), the round-3 hill-climbed set,
+    # else the random-minimal harvest
+    for name in (
+        "corpus_9x9_deep_union.npz",
+        "corpus_9x9_deep_128.npz",
+        "corpus_9x9_adversarial_128.npz",
+    ):
+        adv_path = os.path.join(REPO, "benchmarks", name)
+        if os.path.exists(adv_path):
+            break
     adv = np.load(adv_path)
     hard = np.load(
         os.path.join(REPO, "benchmarks", "corpus_9x9_hard_4096.npz")
