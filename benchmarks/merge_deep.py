@@ -3,8 +3,11 @@
 VERDICT r3 task 5: the routing boundary must rest on more than one mining
 run. This merges every ``corpus_9x9_deep*.npz`` (the round-3 hill-climb,
 the round-4 second-seed hill-climb, the round-4 annealing miner), dedups,
-re-scores everything under the EXACT probe configuration (serving config,
-waves=1) so the classes are comparable, and keeps the deepest KEEP boards.
+re-scores everything under the probe configuration (serving config,
+waves=1) but with the FULL 65536-iteration budget — the deepest mined
+boards exceed serving's 4096-iteration first stage, so the stored
+``sweeps`` are true per-board totals, NOT probe-comparable against the
+serving cap — and keeps the deepest KEEP boards.
 
 The union corpus is what ``exp_frontier_crossover.py`` and
 ``tpu_session.py`` phase 2 consume when present.
@@ -59,7 +62,12 @@ def main():
         per_source[os.path.basename(p)] = {"boards": len(arr), "fresh": fresh}
     boards = np.stack(boards)
 
-    cfg = dict(serving_config(9), waves=1)  # the probe's exact view
+    # the probe's exact view EXCEPT the iteration budget: the deepest mined
+    # boards exceed serving's 4096-iteration first stage (that is what makes
+    # them deep — serving finishes them via the engine's deep retry), so
+    # scoring here runs the full budget to get true per-board sweep counts
+    # and to assert every kept board actually solves
+    cfg = dict(serving_config(9), waves=1, max_iters=65536)
     solve = jax.jit(lambda g: solve_batch(g, SPEC_9, **cfg))
     M = len(boards)
     P2 = 1 << max(0, M - 1).bit_length()

@@ -132,17 +132,22 @@ class SolverEngine:
         # race — the pre-r3 global-flag behavior. "auto": a bucket-path
         # probe at ``frontier_escalate_iters`` answers the easy mass, and
         # only boards still RUNNING at that budget — the deep-search tail
-        # the race exists for — escalate to the frontier. Measured
-        # (benchmarks/exp_frontier_crossover.py, xo_cpu_r3.json): ordinary
-        # hard boards finish within ~110 iterations and the race loses on
-        # them; adversarially mined deep boards (benchmarks/mine_deep.py)
-        # run >=3039 and the race wins 85%+ of them at 25-35% lower
-        # latency even with ONE device's 64 speculative states — the
-        # single-chip case. The 512 default sits in the measured gap. The
-        # race must beat the bucket path somewhere to be more than
-        # decoration (the reference's distributed path vs its local one,
-        # reference node.py:427-475); auto routing sends it exactly that
-        # somewhere.
+        # the race exists for — escalate to the frontier. Measured, round 4
+        # (benchmarks/exp_frontier_crossover.py over the three-run union
+        # corpus — two seeds x two mining methods, merge_deep.py;
+        # benchmarks/xo_union_r4.json, 288 boards): the measured crossover
+        # is 498 lockstep iterations — the race wins 229/250 boards at or
+        # above the 512 default (92%) and only 5/38 below it, 0/32 on
+        # ordinary hard boards, and on beyond-cap boards (all 87 with
+        # iters>=4096) it is ~6.8x faster (45.6 vs 312.2 ms p50) even with
+        # ONE device's 64 speculative states — the single-chip case. Round 3's
+        # single-run corpus put the crossover at 3039 with nothing mined in
+        # the 110-3039 gap; the union fills that gap and lands the boundary
+        # just under the default, so 512 stands validated rather than
+        # one-seed-lucky (VERDICT r3 task 5). The race must beat the bucket
+        # path somewhere to be more than decoration (the reference's
+        # distributed path vs its local one, reference node.py:427-475);
+        # auto routing sends it exactly that somewhere.
         self.frontier_route = frontier_route
         self.frontier_escalate_iters = frontier_escalate_iters
         # Probe→race state handoff (VERDICT r3 task 6): escalated requests
