@@ -116,7 +116,8 @@ def main():
     # these; a real hang can't be staged without wedging the actual claim.
     # Neither fires in the CPU-fallback child: the hang being simulated IS
     # accelerator claim acquisition, which the CPU backend never does.
-    in_fallback = bool(os.environ.get("BENCH_FALLBACK_REASON"))
+    fallback_reason = os.environ.get("BENCH_FALLBACK_REASON")
+    in_fallback = bool(fallback_reason)
     if not in_fallback:
         hang_flag = os.environ.get("BENCH_FAKE_INIT_HANG_ONCE")
         if hang_flag and not os.path.exists(hang_flag):
@@ -155,6 +156,7 @@ def main():
     import numpy as np
 
     from sudoku_solver_distributed_tpu.ops import (
+        cpu_serving_config,
         serving_config,
         solve_batch,
         spec_for_size,
@@ -166,8 +168,15 @@ def main():
     # THE serving configuration — ops.SERVING_CONFIG is the single definition
     # site shared with SolverEngine and __graft_entry__ (per-size staged
     # depth, fused waves, locked sets; measured rationale in ops/config.py),
-    # so this number measures exactly what the serving engine runs.
-    cfg = serving_config(BENCH_SIZE)
+    # so this number measures exactly what the serving engine runs. The
+    # labeled CPU-fallback record instead reports the CPU backend at its
+    # measured best (ops/config.CPU_SERVING_OVERRIDES — the TPU-tuned waves
+    # lose on CPU), with the config named in the record.
+    cfg = (
+        cpu_serving_config(BENCH_SIZE)
+        if in_fallback
+        else serving_config(BENCH_SIZE)
+    )
     solve = jax.jit(lambda g: solve_batch(g, spec, **cfg))
 
     dev_boards = jnp.asarray(boards)
@@ -204,11 +213,11 @@ def main():
     # never frees, the parent re-runs this child on the CPU backend with the
     # reason in the environment — the artifact then records an honest,
     # clearly-tagged number instead of parsed:null.
-    fallback_reason = os.environ.get("BENCH_FALLBACK_REASON")
     if fallback_reason:
         record["metric"] = metric + "_cpu_fallback"
         record["fallback_reason"] = fallback_reason
         record["platform"] = jax.devices()[0].platform
+        record["config"] = cfg  # json serializes the depth tuple as a list
     print(json.dumps(record))
     print(
         f"# batch={BENCH_BATCH} repeats={BENCH_REPEATS} "

@@ -18,7 +18,7 @@ from .validate import (
 )
 from .propagate import propagate, propagate_step
 from .solver import solve_batch, SolveResult
-from .config import SERVING_CONFIG, serving_config
+from .config import SERVING_CONFIG, cpu_serving_config, serving_config
 
 __all__ = [
     "BoardSpec",
@@ -43,4 +43,5 @@ __all__ = [
     "SolveResult",
     "SERVING_CONFIG",
     "serving_config",
+    "cpu_serving_config",
 ]

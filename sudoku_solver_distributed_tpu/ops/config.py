@@ -65,3 +65,23 @@ def serving_config(size: int) -> dict:
         raise ValueError(
             f"no serving config for size {size}; have {sorted(SERVING_CONFIG)}"
         ) from None
+
+
+# The CPU-backend winners, measured 2026-07-30 on the committed hard corpora
+# (1 core, 3-rep best): the TPU-tuned waves values lose on CPU, where extra
+# fused sweeps don't amortize (9×9: waves=1 6,804/s vs serving's waves=3
+# 4,817/s; 16×16: waves=1 596/s confirms serving; 25×25: waves=2 136/s vs
+# serving's waves=1 93/s — iterations 65→36). Used ONLY by bench.py's
+# labeled CPU-fallback path: the headline metric must measure the config
+# the TPU serving engine actually runs, but a `*_cpu_fallback` record
+# should report the CPU backend at its honest best, stated in the record.
+CPU_SERVING_OVERRIDES = {
+    9: dict(waves=1),
+    16: dict(),
+    25: dict(waves=2),
+}
+
+
+def cpu_serving_config(size: int) -> dict:
+    """``serving_config`` with the measured CPU-backend overrides applied."""
+    return {**serving_config(size), **CPU_SERVING_OVERRIDES.get(size, {})}

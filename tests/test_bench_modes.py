@@ -121,6 +121,9 @@ def test_throughput_falls_back_to_labeled_cpu_line(tmp_path):
     assert artifact["metric"] == "puzzles_per_sec_per_chip_hard9x9_cpu_fallback"
     assert "claim never freed" in artifact["fallback_reason"]
     assert artifact["platform"] == "cpu"
+    # the fallback runs (and names) the CPU-measured config, not the
+    # TPU serving config (ops/config.CPU_SERVING_OVERRIDES)
+    assert artifact["config"]["waves"] == 1
     assert "falling back to the CPU backend" in stderr
 
 
