@@ -1,0 +1,73 @@
+"""Per-board probe-view sweep distribution per board size.
+
+Answers "is the 512-iteration escalation default size-safe?": for each
+committed hard corpus, solve every board under the auto-route probe's exact
+view (serving config, waves=1 — what ``SolverEngine._solve_quick`` runs)
+and report the per-board sweep distribution. A board whose sweep count
+exceeds ``frontier_escalate_iters`` would escalate to the race; ordinary
+boards must not (the race loses on them — xo_union_r4.json).
+
+Appends one JSON record per run to ``benchmarks/probe_sweeps_r4.json``.
+Run on CPU: ``python benchmarks/exp_probe_sweeps.py``.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CORPORA = {
+    9: "corpus_9x9_hard_4096.npz",
+    16: "corpus_16x16_hard_2048.npz",
+    25: "corpus_25x25_hard_512.npz",
+}
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.ops import (
+        serving_config,
+        solve_batch,
+        spec_for_size,
+    )
+
+    record = {"experiment": "probe_view_sweeps_per_board", "sizes": {}}
+    for size, fname in CORPORA.items():
+        boards = np.load(os.path.join(REPO, "benchmarks", fname))["boards"]
+        spec = spec_for_size(size)
+        cfg = dict(serving_config(size), waves=1)  # the probe's exact view
+        solve = jax.jit(lambda g, spec=spec, cfg=cfg: solve_batch(g, spec, **cfg))
+        res = jax.block_until_ready(solve(jnp.asarray(boards)))
+        assert bool(np.asarray(res.solved).all()), f"unsolved at size {size}"
+        sweeps = np.asarray(res.validations)  # per-board: sweeps while active
+        qs = np.percentile(sweeps, [50, 90, 95, 99, 100]).astype(int)
+        record["sizes"][size] = {
+            "corpus": fname,
+            "n": int(len(sweeps)),
+            "p50": int(qs[0]),
+            "p90": int(qs[1]),
+            "p95": int(qs[2]),
+            "p99": int(qs[3]),
+            "max": int(qs[4]),
+            "over_512": int((sweeps > 512).sum()),
+        }
+        print(size, record["sizes"][size])
+    record["t"] = round(time.time(), 1)
+    with open(
+        os.path.join(REPO, "benchmarks", "probe_sweeps_r4.json"), "a"
+    ) as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

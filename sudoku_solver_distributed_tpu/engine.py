@@ -147,7 +147,11 @@ class SolverEngine:
         # one-seed-lucky (VERDICT r3 task 5). The race must beat the bucket
         # path somewhere to be more than decoration (the reference's
         # distributed path vs its local one, reference node.py:427-475);
-        # auto routing sends it exactly that somewhere.
+        # auto routing sends it exactly that somewhere. The default is also
+        # safe at the other shipped sizes: per-board probe-view sweep
+        # maxima on the committed corpora are 414 (16x16, p99=122) and 93
+        # (25x25) — benchmarks/exp_probe_sweeps.py, probe_sweeps_r4.json —
+        # so no ordinary board spuriously escalates at 512.
         self.frontier_route = frontier_route
         self.frontier_escalate_iters = frontier_escalate_iters
         # Probe→race state handoff (VERDICT r3 task 6): escalated requests

@@ -31,6 +31,23 @@ def test_engine_solves_hexadoku(engine16):
     assert info["validations"] >= 1
 
 
+def test_hexadoku_auto_route_stays_on_probe():
+    """The 512-iteration escalation default is size-safe: ordinary hexadoku
+    boards (per-board probe-view max 414 sweeps on the committed corpus,
+    p99=122 — benchmarks/exp_probe_sweeps.py, probe_sweeps_r4.json) must
+    be answered by the probe, never spuriously raced."""
+    from test_frontier_routing import _spy_engine
+
+    eng, races = _spy_engine(spec=spec_for_size(16))
+    board = generate_batch(1, 120, size=16, seed=63)[0]
+    solution, info = eng.solve_one(board.tolist())
+    assert solution is not None and oracle_is_valid_solution(solution)
+    mask = board > 0
+    assert (np.asarray(solution)[mask] == board[mask]).all()
+    assert info["routed"] == "bucket-quick"
+    assert races == [] and eng.frontier_escalations == 0
+
+
 def test_node_serves_hexadoku(engine16):
     node = P2PNode("127.0.0.1", 0, engine=engine16, failure_timeout=0.0)
     board = generate_batch(1, 100, size=16, seed=62)[0]
