@@ -184,3 +184,44 @@ def test_fuzz_engine_serving_path_vs_oracle():
             mask = boards[k] > 0
             assert (sols[k][mask] == boards[k][mask]).all(), k
     assert eng.solved_puzzles == sum(solvable)
+
+
+def test_fuzz_auto_route_vs_oracle():
+    """The round-4 single-board routing paths over a randomized corpus:
+    auto-route probe (state-returning and packed variants), escalation to
+    the race, and the probe->race handoff, each verdict pinned to the
+    oracle. A tiny escalation budget forces a large share of boards through
+    the escalate path — including unsatisfiable and multi-solution ones,
+    where a lost handoff subtree or a wrong OVERFLOW answer would surface
+    as a verdict flip."""
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.parallel import default_mesh
+
+    rng = random.Random(SEED + 4)
+    boards = _fuzz_corpus(int(os.environ.get("FUZZ_BOARDS_ROUTE", "32")), rng)
+    solvable = [count_solutions(b.tolist(), limit=1) > 0 for b in boards]
+    mesh = default_mesh()
+    engines = {
+        handoff: SolverEngine(
+            buckets=(1,),
+            frontier_mesh=mesh,
+            frontier_states_per_device=8,
+            frontier_escalate_iters=8,  # most non-trivial boards escalate
+            frontier_handoff=handoff,
+        )
+        for handoff in (True, False)
+    }
+    for handoff, eng in engines.items():
+        for k, board in enumerate(boards):
+            sol, info = eng.solve_one(board.tolist())
+            assert (sol is not None) == solvable[k], (
+                handoff, k, solvable[k], info,
+            )
+            if sol is not None:
+                assert oracle_is_valid_solution(sol), (handoff, k)
+                mask = boards[k] > 0
+                assert (np.asarray(sol)[mask] == boards[k][mask]).all(), (
+                    handoff, k,
+                )
+        assert eng.frontier_escalations > 0, handoff
+        assert eng.frontier_fallbacks == 0, handoff
