@@ -34,6 +34,12 @@ sys.path.insert(0, REPO)
 STATES = int(os.environ.get("XO_STATES", "64"))
 CONTROL = int(os.environ.get("XO_CONTROL", "32"))
 REPS = int(os.environ.get("XO_REPS", "3"))
+SIZE = int(os.environ.get("XO_SIZE", "9"))  # 16: hexadoku crossover table
+_CONTROL_CORPUS = {
+    9: "corpus_9x9_hard_4096.npz",
+    16: "corpus_16x16_hard_2048.npz",
+    25: "corpus_25x25_hard_512.npz",
+}
 
 
 def main():
@@ -53,25 +59,52 @@ def main():
 
     # deepest available adversarial corpus, in preference order: the
     # multi-run union (benchmarks/merge_deep.py — round 4, what makes the
-    # boundary more than one-seed-lucky), the round-3 hill-climbed set,
-    # else the random-minimal harvest
-    for name in (
-        "corpus_9x9_deep_union.npz",
-        "corpus_9x9_deep_128.npz",
-        "corpus_9x9_adversarial_128.npz",
-    ):
-        adv_path = os.path.join(REPO, "benchmarks", name)
-        if os.path.exists(adv_path):
-            break
+    # boundary more than one-seed-lucky), the hill-climbed set, any
+    # annealing-mined corpus (KEEP-size agnostic), else the random-minimal
+    # harvest
+    import glob as _glob
+
+    candidates = [
+        os.path.join(REPO, "benchmarks", f"corpus_{SIZE}x{SIZE}_deep_union.npz"),
+        os.path.join(REPO, "benchmarks", f"corpus_{SIZE}x{SIZE}_deep_128.npz"),
+        *sorted(
+            _glob.glob(
+                os.path.join(
+                    REPO, "benchmarks", f"corpus_{SIZE}x{SIZE}_deep_anneal_*.npz"
+                )
+            ),
+            reverse=True,  # larger KEEP first
+        ),
+        os.path.join(
+            REPO, "benchmarks", f"corpus_{SIZE}x{SIZE}_adversarial_128.npz"
+        ),
+    ]
+    adv_path = next((p for p in candidates if os.path.exists(p)), None)
+    if adv_path is None:
+        sys.exit(
+            f"no deep/adversarial corpus for size {SIZE} — run "
+            f"MINE_SIZE={SIZE} benchmarks/mine_deep_anneal.py first"
+        )
     adv = np.load(adv_path)
+    adv_boards = adv["boards"]
+    adv_limit = int(os.environ.get("XO_ADV_LIMIT", "0"))
+    if adv_limit:
+        adv_boards = adv_boards[:adv_limit]  # smoke runs
+    if SIZE not in _CONTROL_CORPUS:
+        sys.exit(
+            f"XO_SIZE={SIZE} unsupported; have {sorted(_CONTROL_CORPUS)}"
+        )
     hard = np.load(
-        os.path.join(REPO, "benchmarks", "corpus_9x9_hard_4096.npz")
+        os.path.join(REPO, "benchmarks", _CONTROL_CORPUS[SIZE])
     )["boards"][:CONTROL]
-    boards = np.concatenate([hard, adv["boards"]])
+    boards = np.concatenate([hard, adv_boards])
     print(f"# adversarial corpus: {os.path.basename(adv_path)}", file=sys.stderr)
 
+    from sudoku_solver_distributed_tpu.ops import spec_for_size
+
+    spec = spec_for_size(SIZE)
     mesh = default_mesh()
-    eng = SolverEngine(buckets=(1,))  # plain bucket path, serving config
+    eng = SolverEngine(spec, buckets=(1,))  # plain bucket path, serving config
     eng.warmup()
 
     race_kw = dict(
@@ -82,20 +115,19 @@ def main():
         naked_pairs=eng.naked_pairs,
     )
     # warm the race on the first board
-    frontier_solve(boards[-1], mesh, **race_kw)
+    frontier_solve(boards[-1], mesh, spec, **race_kw)
 
     # per-board lockstep iterations under the exact bucket-1 serving view
     # (waves_eff=1) — the quantity the auto-route probe compares against
     # frontier_escalate_iters; a (1,N,N) solve's res.iters IS that board's
     # count (no batch mixing)
     from sudoku_solver_distributed_tpu.ops import (
-        SPEC_9,
         serving_config,
         solve_batch,
     )
 
-    iters_cfg = dict(serving_config(9), waves=1)
-    iters_solve = jax.jit(lambda g: solve_batch(g, SPEC_9, **iters_cfg))
+    iters_cfg = dict(serving_config(SIZE), waves=1)
+    iters_solve = jax.jit(lambda g: solve_batch(g, spec, **iters_cfg))
 
     def board_iters(board):
         res = jax.block_until_ready(iters_solve(jnp.asarray(board[None])))
@@ -111,7 +143,7 @@ def main():
         race_ms = []
         for _ in range(REPS):
             t0 = time.perf_counter()
-            rsol, rinfo = frontier_solve(board, mesh, **race_kw)
+            rsol, rinfo = frontier_solve(board, mesh, spec, **race_kw)
             race_ms.append((time.perf_counter() - t0) * 1e3)
         assert (sol is None) == (rsol is None), f"verdict mismatch board {k}"
         rows.append(
@@ -174,6 +206,7 @@ def main():
     print(
         json.dumps(
             {
+                "size": SIZE,
                 "platform": jax.default_backend(),
                 "mesh_devices": int(mesh.devices.size),
                 "states_per_device": STATES,

@@ -18,7 +18,9 @@ clues come from the chain's reference solution) and every accepted state
 carries a budgeted uniqueness certificate, like the first miner — those are
 correctness constraints, not search-strategy choices.
 
-Emits ``corpus_9x9_deep_anneal_{K}.npz`` (boards + guesses + sweeps).
+Emits ``corpus_{N}x{N}_deep_anneal_{K}.npz`` (boards + guesses + sweeps);
+``MINE_SIZE`` selects the board size (9 default; 16 mines the hexadoku
+deep corpus for the size-specific crossover table, ROADMAP gap #6).
 ``benchmarks/merge_deep.py`` unions the two miners' corpora for the
 crossover experiment.
 
@@ -35,6 +37,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 SECONDS = float(os.environ.get("MINE_SECONDS", "1800"))
+SIZE = int(os.environ.get("MINE_SIZE", "9"))
+_HOLES = {9: 64, 16: 140, 25: 320}
 KEEP = int(os.environ.get("MINE_KEEP", "128"))
 CHAINS = 64            # independent annealing walkers, scored as one batch
 SEED = int(os.environ.get("MINE_SEED", "90210"))
@@ -54,14 +58,17 @@ def main():
     from sudoku_solver_distributed_tpu.models import generate_batch
     from sudoku_solver_distributed_tpu.models.generator import _count, _solve
     from sudoku_solver_distributed_tpu.ops import (
-        SPEC_9,
         serving_config,
         solve_batch,
+        spec_for_size,
     )
 
     rng = random.Random(SEED)
-    cfg = dict(serving_config(9), waves=1)  # the bucket-1/probe view
-    solve = jax.jit(lambda g: solve_batch(g, SPEC_9, **cfg))
+    spec = spec_for_size(SIZE)
+    cfg = dict(serving_config(SIZE), waves=1)  # the bucket-1/probe view
+    solve = jax.jit(lambda g: solve_batch(g, spec, **cfg))
+    # minimal-clue safety floor for mutations (9x9: the classic 17)
+    clue_floor = spec.cells // 5 + 1
 
     def score(boards: np.ndarray):
         """Per-board (sweeps, guesses); pow2-padded like the first miner."""
@@ -69,7 +76,7 @@ def main():
         P2 = 1 << max(0, M - 1).bit_length()
         if P2 > M:
             boards = np.concatenate(
-                [boards, np.zeros((P2 - M, 9, 9), np.int32)]
+                [boards, np.zeros((P2 - M, SIZE, SIZE), np.int32)]
             )
         res = jax.block_until_ready(solve(jnp.asarray(boards)))
         return (
@@ -84,11 +91,11 @@ def main():
         holes = np.argwhere(child == 0)
         op = rng.random()
         k = rng.choice((1, 1, 1, 2, 2, 3))
-        if op < 0.5 and len(filled) > 17 + k:         # remove k clues
+        if op < 0.5 and len(filled) > clue_floor + k:         # remove k clues
             for idx in rng.sample(range(len(filled)), k):
                 i, j = filled[idx]
                 child[i, j] = 0
-        elif op < 0.95 and len(holes) and len(filled) > 17:  # swap
+        elif op < 0.95 and len(holes) and len(filled) > clue_floor:  # swap
             i, j = holes[rng.randrange(len(holes))]
             child[i, j] = solution[i, j]
             filled2 = np.argwhere(child > 0)
@@ -102,7 +109,7 @@ def main():
 
     def fresh_chains(n, tag):
         boards = generate_batch(
-            n, 64, seed=SEED + 7717 * tag, unique=True
+            n, _HOLES[SIZE], size=SIZE, seed=SEED + 7717 * tag, unique=True
         ).astype(np.int32)
         sols = np.stack(
             [np.asarray(_solve(b.tolist()), np.int32) for b in boards]
@@ -129,7 +136,7 @@ def main():
     def save():
         top = sorted(best.values(), key=lambda t: -t[1])[:KEEP]
         out = os.path.join(
-            REPO, "benchmarks", f"corpus_9x9_deep_anneal_{KEEP}.npz"
+            REPO, "benchmarks", f"corpus_{SIZE}x{SIZE}_deep_anneal_{KEEP}.npz"
         )
         np.savez_compressed(
             out,
@@ -191,6 +198,7 @@ def main():
         json.dumps(
             {
                 "method": "simulated_annealing",
+                "size": SIZE,
                 "scorer": "sweeps(validations)",
                 "rounds": rounds,
                 "reheats": reheats,

@@ -151,7 +151,13 @@ class SolverEngine:
         # safe at the other shipped sizes: per-board probe-view sweep
         # maxima on the committed corpora are 414 (16x16, p99=122) and 93
         # (25x25) — benchmarks/exp_probe_sweeps.py, probe_sweeps_r4.json —
-        # so no ordinary board spuriously escalates at 512.
+        # so no ordinary board spuriously escalates at 512. And it pays off
+        # at 16x16 too: over an annealing-mined deep-hexadoku corpus
+        # (xo_16_r4.json, 80 boards) the race wins 58/64 deep boards and
+        # 0/16 ordinary ones, reaching ~37x over the bucket path on the
+        # deepest decile (19 vs 718 ms p50) — the mined corpus starts at
+        # 1712 iters, so 512 sits safely inside the [414, 1712] dead zone
+        # between the deepest ordinary board and the shallowest deep one.
         self.frontier_route = frontier_route
         self.frontier_escalate_iters = frontier_escalate_iters
         # Probe→race state handoff (VERDICT r3 task 6): escalated requests
