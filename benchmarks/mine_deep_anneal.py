@@ -66,6 +66,12 @@ def main():
     rng = random.Random(SEED)
     spec = spec_for_size(SIZE)
     cfg = dict(serving_config(SIZE), waves=1)  # the bucket-1/probe view
+    # MINE_MAX_ITERS caps the scorer's budget below the serving cap: at
+    # 25x25 (serving cap 65536) an uncapped scorer would spend minutes per
+    # round once a chain finds a deep board — the scorer saturating at the
+    # cap just means "at least this deep", which is all the ranking needs
+    if os.environ.get("MINE_MAX_ITERS"):
+        cfg["max_iters"] = int(os.environ["MINE_MAX_ITERS"])
     solve = jax.jit(lambda g: solve_batch(g, spec, **cfg))
     # minimal-clue safety floor for mutations (9x9: the classic 17)
     clue_floor = spec.cells // 5 + 1

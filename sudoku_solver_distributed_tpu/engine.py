@@ -158,6 +158,9 @@ class SolverEngine:
         # deepest decile (19 vs 718 ms p50) — the mined corpus starts at
         # 1712 iters, so 512 sits safely inside the [414, 1712] dead zone
         # between the deepest ordinary board and the shallowest deep one.
+        # 25x25 (xo_25_r4.json, mined deep corpus): race wins 8/8 boards
+        # at-or-above 512 at ~2.5x (31-32 vs 74-81 ms p50) and mostly
+        # loses below it — the default holds at all three shipped sizes.
         self.frontier_route = frontier_route
         self.frontier_escalate_iters = frontier_escalate_iters
         # Probe→race state handoff (VERDICT r3 task 6): escalated requests
