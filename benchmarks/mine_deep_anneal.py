@@ -90,6 +90,16 @@ def main():
             np.asarray(res.guesses)[:M],
         )
 
+    def _key(sweeps, guesses) -> float:
+        """Annealing objective. Guesses tie-break (scaled below any 1-sweep
+        delta): with MINE_MAX_ITERS the sweep score saturates at the cap,
+        and without a tie-break two at-cap boards score delta=0 — moves
+        between them are always accepted and a chain can random-walk from
+        a very deep board to a barely-at-cap one with no restoring signal
+        (code-review r4). Guesses keep climbing past the cap, so they
+        restore the gradient and order the at-cap corpus rows."""
+        return float(sweeps) + float(guesses) / 10000.0
+
     def propose(board: np.ndarray, solution: np.ndarray) -> np.ndarray:
         """One mutation preserving `solution` as a solution."""
         child = board.copy()
@@ -133,14 +143,16 @@ def main():
 
     def bank(i):
         key = cur_b[i].tobytes()
-        if key not in best or best[key][1] < cur_sw[i]:
+        if key not in best or _key(best[key][1], best[key][2]) < _key(
+            cur_sw[i], cur_g[i]
+        ):
             best[key] = (cur_b[i].copy(), int(cur_sw[i]), int(cur_g[i]))
 
     for i in range(CHAINS):
         bank(i)
 
     def save():
-        top = sorted(best.values(), key=lambda t: -t[1])[:KEEP]
+        top = sorted(best.values(), key=lambda t: -_key(t[1], t[2]))[:KEEP]
         out = os.path.join(
             REPO, "benchmarks", f"corpus_{SIZE}x{SIZE}_deep_anneal_{KEEP}.npz"
         )
@@ -167,7 +179,7 @@ def main():
             continue
         prop_sw, prop_g = score(np.stack(proposals))
         for j, i in enumerate(valid):
-            delta = float(prop_sw[j]) - float(cur_sw[i])
+            delta = _key(prop_sw[j], prop_g[j]) - _key(cur_sw[i], cur_g[i])
             if delta >= 0 or rng.random() < np.exp(delta / T[i]):
                 cur_b[i] = proposals[j]
                 cur_sw[i] = prop_sw[j]
