@@ -7,6 +7,13 @@ multi-solution, unsatisfiable, and near-empty — and checks every verdict
 against the independent Python backtracker. Default rounds keep the suite
 fast; set ``FUZZ_BOARDS=2000`` (etc.) for a long campaign (the reference
 has no analog of any of this, SURVEY.md §4).
+
+Long-campaign caveat (measured, round 4): the wall-clock ceiling is the
+ORACLE side, not the kernel — ``count_solutions`` on an unlucky 16×16/25×25
+draw (near-empty or corrupted boards) is unbounded backtracking and can
+burn an hour on one board (seeds 999001 at size 25 did; the same seed's
+9×9 campaigns finish in seconds). Scale ``FUZZ_BOARDS`` for the 9×9 tests
+freely; treat the 16/25 tests' defaults as the oracle-budget they are.
 """
 
 import os
