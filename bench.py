@@ -696,6 +696,10 @@ def main_with_retry():
                 }
             )
         )
+        # rc=3 keeps the give-up visible to pipeline callers keying on the
+        # exit code — the *_unmeasured value-0.0 line is a failure record,
+        # not a measurement (ADVICE r4)
+        sys.exit(3)
     sys.exit(0)
 
 

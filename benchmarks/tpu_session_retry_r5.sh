@@ -1,0 +1,30 @@
+#!/bin/bash
+# Patient single-client TPU probe loop, round 5 (claim discipline,
+# docs/OPERATIONS.md): each attempt is ONE process that either completes
+# the measurement session or dies by its own watchdog — never killed
+# externally.
+#
+# Round-5 change (VERDICT r4 weak #1): assume the claim window is short.
+# The session's init watchdog waits 1500 s (the process sits IN LINE for
+# the claim rather than giving up at 420 s), and the inter-attempt sleep
+# is adaptive: a quick death (raise — sick terminal) backs off 600 s so
+# the terminal isn't hammered; a watchdog death (full patient wait) retries
+# after only 60 s, so the chip is being waited on ~95% of the round.
+#
+# Exits when the session writes a "done" marker (all phases measured or
+# the STOP_AT deadline inside tpu_session_r5.py fired).
+cd /root/repo
+for i in $(seq 1 200); do
+  echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> benchmarks/tpu_session_r5.log
+  t0=$(date +%s)
+  python benchmarks/tpu_session_r5.py >> benchmarks/tpu_session_r5.log 2>&1
+  rc=$?
+  dur=$(( $(date +%s) - t0 ))
+  echo "=== attempt $i exited rc=$rc after ${dur}s $(date -u +%H:%M:%S) ===" \
+    >> benchmarks/tpu_session_r5.log
+  if grep -q '"phase": "done"' benchmarks/tpu_session_r5.jsonl 2>/dev/null; then
+    echo "=== session finished (done marker) ===" >> benchmarks/tpu_session_r5.log
+    exit 0
+  fi
+  if [ "$dur" -lt 120 ]; then sleep 600; else sleep 60; fi
+done

@@ -772,7 +772,19 @@ class SolverEngine:
                     return probed
         if use_frontier:
             try:
-                return self._frontier_solve(arr, seed_states)
+                solution, info = self._frontier_solve(arr, seed_states)
+                if solution is None and info.get("capped"):
+                    # same contract as the bucket path below: a race whose
+                    # every subtree OVERFLOWed or was still RUNNING at
+                    # max_iters has NOT proven the board unsolvable
+                    # (ADVICE r4) — the HTTP surface still answers the
+                    # reference's exact "No solution found" body, so the
+                    # distinction is logged + carried in info["capped"]
+                    logger.warning(
+                        "solve_one: frontier race budget/stack exhausted — "
+                        "board not finished, NOT proven unsolvable"
+                    )
+                return solution, info
             except Exception:  # noqa: BLE001 — any race failure
                 # A dead/failed frontier path (e.g. a failed collective
                 # stopping the multi-host serving loop) must not take

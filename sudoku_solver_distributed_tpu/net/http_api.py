@@ -24,6 +24,25 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 logger = logging.getLogger(__name__)
 
 
+def _board_error(sudoku, size: int) -> str | None:
+    """Semantic body validation: reject JSON-valid-but-malformed boards
+    before they reach the engine (VERDICT r4 task 2). The reference crashes
+    uncaught on these — `board[row][col]` on a string, a ragged grid, or a
+    non-9×9 grid raises in the handler thread and the client gets an empty
+    reply (reference node.py:672-690 [verified live]). Returns a reason
+    string when invalid, None when the board is a clean ``size``×``size``
+    grid of ints in 0..size."""
+    if not isinstance(sudoku, list) or len(sudoku) != size:
+        return f"board must be a {size}x{size} array"
+    for row in sudoku:
+        if not isinstance(row, list) or len(row) != size:
+            return f"board must be a {size}x{size} array"
+        for v in row:
+            if type(v) is not int or not 0 <= v <= size:
+                return f"cells must be integers in 0..{size}"
+    return None
+
+
 class SudokuHTTPHandler(BaseHTTPRequestHandler):
     p2p_node = None       # set by make_http_server
     expose_metrics = False  # opt-in /metrics route (CLI --metrics); default
@@ -53,6 +72,13 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             except (ValueError, KeyError, UnicodeDecodeError):
                 # record before replying: a client may poll /metrics the
                 # instant its response arrives
+                self._record("/solve", t0, error=True)
+                self._send_response({"error": "Invalid request"}, 400)
+                return
+            size = self.p2p_node.engine.spec.size
+            reason = _board_error(sudoku, size)
+            if reason is not None:
+                logger.info("rejected /solve body: %s", reason)
                 self._record("/solve", t0, error=True)
                 self._send_response({"error": "Invalid request"}, 400)
                 return

@@ -339,14 +339,23 @@ def _make_racer_cached(
         solution = sol_g[winner]
         found_any = has_g.any()
         validations = jax.lax.psum(st.validations.sum(), "data")
+        # undecided: some subtree OVERFLOWed its guess stack or was still
+        # RUNNING at max_iters — without a solution elsewhere, "not found"
+        # is then a budget verdict, NOT a proof of unsatisfiability
+        # (ADVICE r4: the probe-level OVERFLOW contract, one layer down)
+        local_undec = (
+            (st.status == S.RUNNING) | (st.status == S.OVERFLOW)
+        ).any()
+        undecided = jax.lax.psum(local_undec.astype(jnp.int32), "data") > 0
         # one packed output row = one device→host transfer per request
-        # (three outputs would be three fetches — ~an RTT each on a
+        # (separate outputs would be separate fetches — ~an RTT each on a
         # tunneled device; same trick as engine.SolverEngine._run)
         return jnp.concatenate(
             [
                 solution,
                 found_any.astype(jnp.int32)[None],
                 validations[None],
+                undecided.astype(jnp.int32)[None],
             ]
         )
 
@@ -442,5 +451,11 @@ def frontier_solve(
         "handoff": initial_states is not None,
     }
     if not found:
+        # "capped" mirrors the bucket path's marker (engine.solve_batch_np):
+        # True means some subtree hit its stack (OVERFLOW) or the iteration
+        # budget with states still RUNNING — the board is NOT proven
+        # unsolvable. None + capped=False is a genuine UNSAT proof: every
+        # subtree of a covering decomposition was refuted (ADVICE r4).
+        info["capped"] = bool(packed[C + 2])
         return None, info
     return packed[:C].reshape(spec.size, spec.size).tolist(), info

@@ -242,15 +242,20 @@ class FrontierServingLoop:
         whose peer died host-locally therefore REPORTS dead instead of
         alive-forever (ADVICE r3)."""
         now = time.monotonic()
+        started = self._thread is not None
         stalled = False
-        if not self._stopped.is_set() and self._thread is not None:
+        if started and not self._stopped.is_set():
             since = self._collective_since
             if since is not None:
                 stalled = now - since > self.collective_stall_after_s
             else:
                 stalled = now - self._last_tick > self.stall_after_s
+        # a loop constructed but never start()ed is NOT alive — "started"
+        # carries the distinct state so the operator can tell "never
+        # launched" from "died" (ADVICE r4)
         return {
-            "alive": not self._stopped.is_set() and not stalled,
+            "alive": started and not self._stopped.is_set() and not stalled,
+            "started": started,
             "stalled": stalled,
             "last_tick_age_s": round(now - self._last_tick, 1),
             "restarts": self.restarts,

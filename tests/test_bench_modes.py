@@ -14,7 +14,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_bench(env_extra, timeout=480):
+def run_bench(env_extra, timeout=480, want_rc=0):
     env = dict(os.environ, **env_extra)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -24,7 +24,10 @@ def run_bench(env_extra, timeout=480):
         text=True,
         timeout=timeout,
     )
-    assert proc.returncode == 0, proc.stderr[-2000:]
+    # rc=3 marks the give-up path: the *_unmeasured value-0.0 line is a
+    # failure record, not a measurement, and pipeline callers keying on
+    # the exit code must see it (ADVICE r4)
+    assert proc.returncode == want_rc, (proc.returncode, proc.stderr[-2000:])
     json_lines = [
         ln for ln in proc.stdout.splitlines() if ln.startswith("{")
     ]
@@ -139,7 +142,8 @@ def test_throughput_last_resort_line_when_fallback_fails(tmp_path):
             "BENCH_INIT_TIMEOUT_S": "2",
             "BENCH_TOTAL_BUDGET_S": "6",
             "BENCH_RETRY_BACKOFF_S": "0.1",
-        }
+        },
+        want_rc=3,
     )
     assert artifact["metric"] == "puzzles_per_sec_per_chip_hard9x9_unmeasured"
     assert artifact["value"] == 0.0
@@ -160,7 +164,8 @@ def test_throughput_fallback_timeout_yields_last_resort_line(tmp_path):
             "BENCH_TOTAL_BUDGET_S": "6",
             "BENCH_RETRY_BACKOFF_S": "0.1",
             "BENCH_FALLBACK_RESERVE_S": "8",
-        }
+        },
+        want_rc=3,
     )
     assert "exceeded its reserve" in stderr
     assert artifact["metric"] == "puzzles_per_sec_per_chip_hard9x9_unmeasured"

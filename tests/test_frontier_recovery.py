@@ -197,6 +197,16 @@ def test_hung_round_times_out_to_bucket_fallback(readme_puzzle):
     hang_forever.set()
 
 
+def test_health_not_started_reports_not_alive():
+    """ADVICE r4: a loop constructed but never start()ed must not report
+    alive=true forever — it is distinctly 'not started'."""
+    loop = FrontierServingLoop(mesh=None)
+    h = loop.health()
+    assert h["alive"] is False
+    assert h["started"] is False
+    assert h["stalled"] is False
+
+
 def test_late_result_from_timed_out_request_is_discarded():
     """A request that times out may still finish in the collective later;
     its late result must never be served as the NEXT request's answer

@@ -90,6 +90,38 @@ def test_auto_route_probe_overflow_escalates(readme_puzzle):
         assert oracle_is_valid_solution(solution)
 
 
+def test_race_capped_is_not_proven_unsat(readme_puzzle):
+    """ADVICE r4: a race that exhausts its iteration budget with subtrees
+    still RUNNING (or whose stacks OVERFLOWed) answers None + capped=True —
+    the board is NOT proven unsolvable. None + capped=False remains a real
+    UNSAT proof (every subtree of a covering decomposition refuted)."""
+    from sudoku_solver_distributed_tpu.parallel import frontier_solve
+
+    mesh = default_mesh()
+    # 2 lockstep iterations cannot finish the README 8-clue board's subtrees
+    sol, info = frontier_solve(
+        readme_puzzle, mesh, states_per_device=8, max_iters=2
+    )
+    assert sol is None
+    assert info["capped"] is True
+
+    # OVERFLOW shape: a 1-deep guess stack overflows on every deep subtree
+    sol, info = frontier_solve(
+        readme_puzzle, mesh, states_per_device=8, max_depth=1, max_iters=256
+    )
+    if sol is None:  # depth 1 may still solve via propagation-heavy subtrees
+        assert info["capped"] is True
+    else:
+        assert oracle_is_valid_solution(sol)
+
+    # genuine UNSAT: refuted everywhere → None and NOT capped
+    board = np.zeros((9, 9), np.int32)
+    board[0, 0] = board[0, 1] = 5
+    sol, info = frontier_solve(board, mesh, states_per_device=8)
+    assert sol is None
+    assert info["capped"] is False
+
+
 def test_explicit_frontier_true_bypasses_probe(readme_puzzle):
     eng, race_calls = _spy_engine()
     solution, info = eng.solve_one(readme_puzzle, frontier=True)
