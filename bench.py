@@ -398,6 +398,11 @@ def main_farm():
 
     n_nodes = int(os.environ.get("BENCH_FARM_NODES", "4"))
     reps = int(os.environ.get("BENCH_FARM_REPS", "20"))
+    # BENCH_FARM_HOLES: the farmed-cell count. 5 reproduces the reference's
+    # flagship measurement; 40-60 is the realistic-load profile VERDICT r4
+    # task 6 asks for (the farm answers each hole with a full-board worker
+    # solve, so cost scales ~linearly in holes — see OPERATIONS.md).
+    holes = int(os.environ.get("BENCH_FARM_HOLES", "5"))
     repo = os.path.dirname(os.path.abspath(__file__))
     base = 19000 + os.getpid() % 600
     http_ports = [base + i for i in range(n_nodes)]
@@ -408,9 +413,18 @@ def main_farm():
     platform = os.environ.get("BENCH_PLATFORM", "cpu")
     extra = ["--platform", platform] if platform else []
 
-    board = generate_batch(1, 5, seed=180, unique=True)[0].tolist()
+    board = generate_batch(1, holes, seed=180, unique=True)[0].tolist()
     body = json.dumps({"sudoku": board}).encode()
     target = http_ports[1]  # non-anchor master, the SURVEY-verified flow
+
+    def scrape_stats():
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{target}/stats", timeout=5
+            ) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
 
     def post_solve(timeout=300.0):
         req = urllib.request.Request(
@@ -467,6 +481,7 @@ def main_farm():
             ms, _ = post_solve()
             fast = fast + 1 if ms < 500 else 0
 
+        stats_before = scrape_stats()
         times = []
         for _ in range(reps):
             ms, payload = post_solve()
@@ -475,22 +490,46 @@ def main_farm():
             ), "farm returned an incomplete board"
             times.append(ms)
         times = np.asarray(times)
+        stats_after = scrape_stats()
         p50 = float(np.percentile(times, 50))
-        print(
-            json.dumps(
-                {
-                    "metric": f"p50_solve_http_{n_nodes}node_farm_5hole9x9",
-                    "value": round(p50, 2),
-                    "unit": "ms",
-                    "vs_baseline": round(180.0 / p50, 4),
-                }
+        # baselines: the reference has exactly two multi-node datapoints —
+        # 180 ms at 5 holes (incomplete board, SURVEY.md §3.2) and 25 s at
+        # 30 holes (5 cells unsolved, §6). vs_baseline is only emitted at a
+        # comparable hole count; other workloads have no reference number
+        # and a ratio would be apples-to-oranges (code-review r5).
+        if holes <= 5:
+            baseline_ms = 180.0
+        elif 25 <= holes <= 35:
+            baseline_ms = 25000.0
+        else:
+            baseline_ms = None
+        record = {
+            "metric": f"p50_solve_http_{n_nodes}node_farm_{holes}hole9x9",
+            "value": round(p50, 2),
+            "unit": "ms",
+            "vs_baseline": (
+                round(baseline_ms / p50, 4) if baseline_ms else None
+            ),
+        }
+        # cost-model evidence (VERDICT r4 task 6): each farmed request
+        # costs ~holes worker full-board solves + 1 authoritative master
+        # solve; the gossiped validation counters carry the network-wide
+        # engine effort (per-sweep accounting, SURVEY.md §2)
+        if stats_before and stats_after:
+            record["validations_delta_total"] = (
+                stats_after["all"]["validations"]
+                - stats_before["all"]["validations"]
             )
-        )
+            record["expected_engine_solves"] = reps * (holes + 1)
+        print(json.dumps(record))
         print(
-            f"# nodes={n_nodes} reps={reps} platform={platform or 'default'} "
+            f"# nodes={n_nodes} reps={reps} holes={holes} "
+            f"platform={platform or 'default'} "
             f"p50={p50:.2f}ms p95={float(np.percentile(times, 95)):.2f}ms "
-            f"min={times.min():.2f}ms (reference: 180 ms with an unsolved "
-            f"cell left on the board; completeness asserted here)",
+            f"min={times.min():.2f}ms baseline="
+            f"{f'{baseline_ms:.0f}ms' if baseline_ms else 'none (no comparable reference datapoint)'} "
+            f"(reference returned INCOMPLETE boards at both its farm "
+            f"datapoints; completeness asserted here on every reply)",
             file=sys.stderr,
         )
 

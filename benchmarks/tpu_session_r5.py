@@ -57,7 +57,22 @@ STOP_FLAG = os.path.join(REPO, "benchmarks", "tpu_stop")
 # end-of-round bench.py run (the r4 lesson: VERDICT weak #1).
 STOP_AT = float(os.environ.get("TPU_SESSION_STOP_AT", "1785502000"))
 INIT_TIMEOUT_S = float(os.environ.get("TPU_INIT_TIMEOUT_S", "1500"))
+# A single phase blocked past this is a wedged-tunnel compile RPC (the
+# 2026-07-31 attempt-1 shape: backend_up in 0.1 s, then the first compile
+# never returned), not a slow compile — healthy serving-config compiles
+# measure minutes at most. The phase watchdog emits the diagnosis and
+# exits 3 by its own hand so the wrapper can retry.
+PHASE_TIMEOUT_S = float(os.environ.get("TPU_PHASE_TIMEOUT_S", "2400"))
 TARGET_PER_CHIP = 100_000.0  # BASELINE.md 9x9 north star
+
+# Persistent compile cache: a serving-config compile that succeeds ONCE is
+# reused by every later attempt/phase (and by bench.py children pointed at
+# the same dir), so a short claim window is spent measuring, not compiling.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, "benchmarks", ".jax_cache_tpu")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 
 def emit(record, path=OUT):
@@ -78,6 +93,51 @@ def write_artifact(name, payload):
 
 def should_stop():
     return os.path.exists(STOP_FLAG) or time.time() > STOP_AT
+
+
+class PhaseWatchdog:
+    """Re-armable deadline for device-blocking phases: if a phase blocks
+    past its budget the process emits the diagnosis and exits 3 BY ITS OWN
+    HAND (never an external kill — docs/OPERATIONS.md claim discipline),
+    so the retry wrapper gets another attempt instead of waiting forever
+    on a wedged compile RPC."""
+
+    def __init__(self):
+        self._label = None
+        self._deadline = None
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def arm(self, label: str, budget_s: float = PHASE_TIMEOUT_S):
+        with self._lock:
+            self._label = label
+            self._deadline = time.time() + budget_s
+
+    def disarm(self):
+        with self._lock:
+            self._label = None
+            self._deadline = None
+
+    def _run(self):
+        while True:
+            time.sleep(5)
+            with self._lock:
+                expired = (
+                    self._deadline is not None and time.time() > self._deadline
+                )
+                label = self._label
+            if expired:
+                emit(
+                    {
+                        "phase": "phase_timeout",
+                        "name": label,
+                        "budget_s": PHASE_TIMEOUT_S,
+                        "detail": "device call never returned — wedged "
+                        "tunnel/compile RPC, not a slow compile",
+                    }
+                )
+                os._exit(3)
 
 
 def time_solve(solve, dev_boards, batch, repeats=5):
@@ -180,15 +240,19 @@ def main():
             REPO, "benchmarks", f"corpus_{size}x{size}_hard_{batch}.npz"
         )
 
+    dog = PhaseWatchdog()
+
     def run_config(size, boards, name, repeats=5, **kw):
         spec = spec_for_size(size)
         solve = jax.jit(lambda g: solve_batch(g, spec, **kw))
         dev = jnp.asarray(boards)
+        dog.arm(name)
         t0 = time.perf_counter()
         res = jax.block_until_ready(solve(dev))
         compile_s = round(time.perf_counter() - t0, 1)
         solved = bool(np.asarray(res.solved).all())
         stats = time_solve(solve, dev, len(boards), repeats=repeats)
+        dog.disarm()
         emit(
             {
                 "phase": "measure",
@@ -275,7 +339,9 @@ def main():
                 frontier_mesh=mesh,
                 frontier_states_per_device=64,
             )
+            dog.arm("engine_warmup")
             eng.warmup()
+            dog.disarm()
         except Exception as e:  # noqa: BLE001
             emit({"phase": "error", "name": "crossover_setup", "err": repr(e)[:600]})
             eng = None
@@ -290,6 +356,7 @@ def main():
                 naked_pairs=eng.naked_pairs,
             )
             rows = []
+            dog.arm("crossover")
             for board in picks:
                 t0 = time.perf_counter()
                 sol, info = eng.solve_one(board, frontier=False)
@@ -306,6 +373,7 @@ def main():
                         "verdicts_agree": (sol is None) == (rsol is None),
                     }
                 )
+            dog.disarm()
             emit({"phase": "frontier_crossover_1chip", "rows": rows})
             write_artifact(
                 "xo_9_r5.json",
@@ -323,6 +391,7 @@ def main():
     if eng is not None and not should_stop():
         try:
             auto_rows = []
+            dog.arm("auto_route")
             for board in picks[:8]:
                 before = eng.frontier_escalations
                 t0 = time.perf_counter()
@@ -335,6 +404,7 @@ def main():
                         "solved": sol is not None,
                     }
                 )
+            dog.disarm()
             emit({"phase": "auto_route_e2e", "rows": auto_rows})
         except Exception as e:  # noqa: BLE001
             emit({"phase": "error", "name": "auto_route", "err": repr(e)[:600]})
@@ -385,6 +455,7 @@ def main():
                 lambda g: solve_batch(g, spec, **{**cfg9, "waves": 1})
             )
             one = jnp.asarray(b9[:1])
+            dog.arm("latency1")
             jax.block_until_ready(solve1(one))
             lat = []
             for i in range(40):
@@ -411,6 +482,7 @@ def main():
                     "n": n_async,
                 }
             )
+            dog.disarm()
             write_artifact(
                 "latency_tpu_r5.json",
                 {
@@ -430,6 +502,7 @@ def main():
     if not should_stop():
         try:
             emit({"phase": "pallas_attempt_start"})
+            dog.arm("pallas_compile")
             from sudoku_solver_distributed_tpu.ops.pallas_solver import (
                 solve_batch_pallas,
             )
@@ -447,6 +520,7 @@ def main():
             )
             jax.block_until_ready(solve_p(jnp.asarray(b9)))
             stats = time_solve(solve_p, jnp.asarray(b9), len(b9))
+            dog.disarm()
             emit(
                 {
                     "phase": "pallas_result",

@@ -16,6 +16,26 @@ Reproduces the reference's topology semantics (reference node.py:195-260,
   * ``peers_to_reconnect`` tracks liveness flags exactly as the reference
     does (True on sight, False on disconnect, revived on re-sight).
 
+Beyond the reference (churn-soak findings, tests/test_churn_soak.py):
+
+  * **tombstones** — a pruned address is remembered dead for
+    ``tombstone_ttl_s``; the grow-only union merge filters tombstoned
+    addresses from incoming floods, so a node holding a stale pre-death
+    view can no longer *resurrect* a dead peer network-wide by re-flooding
+    it (the add-wins race the reference's merge loses permanently,
+    reference node.py:227-231). Direct evidence of life (any datagram
+    from the address — ``mark_alive``) clears the tombstone instantly, so
+    a false-positive death or a genuine rejoin heals on first contact.
+  * **stale-flood pushback** — tombstoned addresses seen in an incoming
+    flood are reported to the caller (``drain_stale``), which answers the
+    sender's neighborhood with ``disconnect`` relays: the deletion chases
+    the stale view instead of waiting for the holder to stumble on it.
+  * **orphan re-dial** — ``reconnect_candidate`` rotates through
+    ``peers_to_reconnect`` so a fully-orphaned node (e.g. the original
+    anchor after every neighbor died: it has no ``anchor_node`` to retry)
+    re-dials remembered addresses until the network heals. The reference
+    keeps this very structure and never dials from it (SURVEY.md §5).
+
 The ``all_peers`` dict is the GET /network body — byte-identical shape.
 Thread-safe behind one lock (the reference mutates these sets from two
 threads, unlocked).
@@ -24,53 +44,117 @@ threads, unlocked).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Set
 
 
 
 class Membership:
-    def __init__(self, node_id: str):
+    def __init__(self, node_id: str, tombstone_ttl_s: float = 30.0):
         self.node_id = node_id
+        self.tombstone_ttl_s = tombstone_ttl_s
         self._lock = threading.Lock()
         self.peers_out: Set[str] = set()   # peers that dialed us
         self.peers_in: Set[str] = set()    # peers we dialed
         self.all_peers: Dict[str, List[str]] = {}
         self.peers_to_reconnect: Dict[str, bool] = {}
+        self._tombstones: Dict[str, float] = {}  # addr -> monotonic expiry
+        self._stale_seen: List[str] = []         # pushback queue (drain_stale)
+        self._redial_rotation: int = 0
 
     # -- join --------------------------------------------------------------
     def on_connect(self, address: str) -> None:
-        """Inbound ``connect`` (we are the anchor side)."""
+        """Inbound ``connect`` (we are the anchor side). A live dial is
+        ground truth: it clears any tombstone for the dialer."""
         with self._lock:
+            self._tombstones.pop(address, None)
             self.peers_out.add(address)
             self.peers_to_reconnect[address] = True
 
     def on_connected(self, address: str) -> None:
         """Inbound ``connected`` (our dial was accepted)."""
         with self._lock:
+            self._tombstones.pop(address, None)
             self.peers_in.add(address)
             self.peers_to_reconnect[address] = True
             self.all_peers[address] = [self.node_id]
 
+    def mark_alive(self, address: str) -> None:
+        """Direct evidence of life (a datagram FROM ``address``): clear its
+        tombstone so a false-positive death heals on first contact."""
+        with self._lock:
+            self._tombstones.pop(address, None)
+
     # -- flood merge -------------------------------------------------------
     def merge_all_peers(self, received: Dict[str, List[str]]) -> bool:
-        """Grow-only union merge; True if our view changed (=> re-flood)."""
+        """Union merge with tombstone filtering; True if our view changed
+        (=> re-flood). Tombstoned addresses in ``received`` are recorded
+        for ``drain_stale`` pushback instead of being merged."""
         changed = False
+        now = time.monotonic()
         with self._lock:
+            self._purge_tombstones(now)
+            stale = set()
             for parent, children in received.items():
+                live_children = []
+                for addr in children:
+                    if addr in self._tombstones:
+                        stale.add(addr)
+                    else:
+                        live_children.append(addr)
+                if parent in self._tombstones:
+                    stale.add(parent)
+                    # the parent is dead but its children may be live
+                    # survivors only ever advertised through it — remember
+                    # them as re-dial candidates even though there is no
+                    # live edge to merge them under (code-review r5)
+                    for addr in live_children:
+                        if (
+                            addr != self.node_id
+                            and self.peers_to_reconnect.get(addr) is not True
+                        ):
+                            self.peers_to_reconnect[addr] = True
+                    continue
                 if parent not in self.all_peers:
-                    self.all_peers[parent] = list(children)
-                    changed = True
+                    # an entry whose every child was tombstone-filtered is
+                    # itself stale — adding {parent: []} would pollute the
+                    # view (pruning deletes emptied parents)
+                    if live_children or not children:
+                        self.all_peers[parent] = list(live_children)
+                        changed = True
                 else:
-                    merged = sorted(set(self.all_peers[parent]) | set(children))
+                    merged = sorted(
+                        set(self.all_peers[parent]) | set(live_children)
+                    )
                     if merged != sorted(self.all_peers[parent]):
                         self.all_peers[parent] = merged
                         changed = True
-            # revive liveness flags for any address we can now see
+            self._stale_seen.extend(
+                a for a in sorted(stale) if a not in self._stale_seen
+            )
+            # revive liveness flags for any address we can now see, and
+            # REMEMBER every address (reconnect_candidate's pool: a node
+            # orphaned later must be able to re-dial survivors it only
+            # ever knew transitively, not just its own ex-neighbors)
             for parent, children in self.all_peers.items():
                 for addr in (parent, *children):
-                    if self.peers_to_reconnect.get(addr) is False:
+                    if addr == self.node_id:
+                        continue
+                    if self.peers_to_reconnect.get(addr) is not True:
                         self.peers_to_reconnect[addr] = True
         return changed
+
+    def drain_stale(self) -> List[str]:
+        """Tombstoned addresses observed in incoming floods since the last
+        drain — the caller relays ``disconnect`` for each so the deletion
+        reaches whichever node still holds the stale view."""
+        with self._lock:
+            out, self._stale_seen = self._stale_seen, []
+            return out
+
+    def _purge_tombstones(self, now: float) -> None:
+        for addr in [a for a, t in self._tombstones.items() if t < now]:
+            del self._tombstones[addr]
 
     def second_link_target(self) -> Optional[str]:
         """If singly-connected, an address worth dialing for redundancy
@@ -97,6 +181,8 @@ class Membership:
         """
         redial: Optional[str] = None
         with self._lock:
+            now = time.monotonic()
+            self._purge_tombstones(now)
             self.peers_in.discard(address)
             self.peers_out.discard(address)
 
@@ -114,16 +200,54 @@ class Membership:
 
             if changed:
                 self.peers_to_reconnect[address] = False
+                # Tombstone only when the disconnect actually changed our
+                # view: a relayed pushback about an already-pruned address
+                # must NOT renew the tombstone, or mutually-renewing relays
+                # could exclude a same-address rejoin indefinitely
+                # (code-review r5). Worst case after a rejoin inside the
+                # TTL: ~one TTL of pushback churn, then the un-renewed
+                # tombstones expire and the rejoin merges everywhere.
+                self._tombstones[address] = now + self.tombstone_ttl_s
 
             if was_parent_of_us:
-                if self.all_peers:
-                    redial = next(iter(self.all_peers))
+                # never redial ourselves (a key == node_id appears whenever
+                # someone's second-link flood records us as a parent; a
+                # self-dial would handshake with ourselves and write a
+                # {self: [self]} loop into every view — verify r5) nor the
+                # peer that just departed
+                for candidate in self.all_peers:
+                    if candidate not in (self.node_id, address):
+                        redial = candidate
+                        break
                 else:
                     for sibling in before.get(address, []):
                         if sibling != self.node_id:
                             redial = sibling
                             break
         return changed, redial
+
+    def reconnect_candidate(self) -> Optional[str]:
+        """An address worth re-dialing when we have no neighbors left.
+
+        Rotates through ``peers_to_reconnect`` (the reference's own
+        remembered-peers structure, which it populates but never dials
+        from — SURVEY.md §5), preferring addresses last seen alive (flag
+        True) and skipping currently-tombstoned ones. Returns None when
+        nothing is remembered."""
+        with self._lock:
+            self._purge_tombstones(time.monotonic())
+            known = [
+                a
+                for a in self.peers_to_reconnect
+                if a != self.node_id and a not in self._tombstones
+            ]
+            if not known:
+                return None
+            known.sort(
+                key=lambda a: (not self.peers_to_reconnect.get(a, False), a)
+            )
+            self._redial_rotation += 1
+            return known[self._redial_rotation % len(known)]
 
     # -- views -------------------------------------------------------------
     def neighbors(self) -> List[str]:

@@ -39,6 +39,11 @@ logger = logging.getLogger(__name__)
 TASK_DEADLINE_S = 5.0       # reassign a dispatched cell after this long
 SOLVE_WAIT_SLICE_S = 0.05   # condition-wait granularity in the dispatch loop
 GOSSIP_INTERVAL_S = 1.0     # periodic stats broadcast (see P2PNode.run)
+ANTI_ENTROPY_S = 5.0        # periodic all_peers re-flood: bounds how long a
+#                             missed deletion/join flood can leave views
+#                             diverged (drop-lossy wire, test_churn_soak.py);
+#                             same wire message, reference nodes merge it
+#                             exactly like any change-triggered flood
 FAILURE_TIMEOUT_S = 5.0     # declare a silent neighbor dead after this long
 
 
@@ -54,6 +59,7 @@ class P2PNode:
         failure_timeout: float = FAILURE_TIMEOUT_S,
         metrics=None,
         fault_injector=None,
+        tombstone_ttl_s: float = 30.0,
     ):
         self.host = host
         self.port = port
@@ -64,7 +70,7 @@ class P2PNode:
         self.engine = engine if engine is not None else SolverEngine()
         self.limiter = HandicapLimiter(base_delay=handicap)
         self._solved_count = 0
-        self.membership = Membership(self.id)
+        self.membership = Membership(self.id, tombstone_ttl_s=tombstone_ttl_s)
         self.stats = StatsGossip(self.id, self._own_counters)
 
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -111,6 +117,7 @@ class P2PNode:
         self.failure_timeout = failure_timeout
         self._last_seen: Dict[str, float] = {}
         self._last_tick = time.monotonic()
+        self._stale_pushback: Dict[str, float] = {}  # addr -> last relay time
         # request-latency recorder fed by the HTTP layer (utils/profiling.py);
         # optional so bare nodes pay nothing
         self.metrics = metrics
@@ -206,13 +213,22 @@ class P2PNode:
         # "127.0.0.1": the watched key would never refresh and a healthy
         # neighbor would be declared dead forever.)
         sender = msg.get("address") or msg.get("origin")
-        if isinstance(sender, str):
+        if isinstance(sender, str) and mtype != "disconnect":
+            # (a disconnect's "address" names the DEPARTED node, not the
+            # sender — refreshing it would revive the peer being buried)
             self._last_seen[sender] = time.monotonic()
+            # direct datagram = proof of life: clears any tombstone so a
+            # false-positive death or a fast rejoin heals on first contact
+            self.membership.mark_alive(sender)
         if mtype == "connect":
+            if msg["address"] == self.id:
+                return  # never handshake with ourselves (verify r5)
             self.membership.on_connect(msg["address"])
             self.send_to(msg["address"], wire.connected_msg(self.id))
 
         elif mtype == "connected":
+            if msg["address"] == self.id:
+                return
             self.membership.on_connected(msg["address"])
             self.broadcast_all_peers()
 
@@ -220,6 +236,28 @@ class P2PNode:
             self.broadcast_stats()  # same trigger as reference node.py:217
             if self.membership.merge_all_peers(msg["all_peers"]):
                 self.broadcast_all_peers()
+            # stale-flood pushback: the flood carried addresses we hold
+            # tombstones for — some node still has the pre-death view, so
+            # chase it with disconnect relays (rate-limited per address)
+            now = time.monotonic()
+            stale_addrs = self.membership.drain_stale()
+            if stale_addrs:
+                # prune rate-limit entries past the tombstone TTL — they
+                # are useless once the tombstone expired, and high churn
+                # would otherwise grow this map forever (code-review r5)
+                ttl = self.membership.tombstone_ttl_s
+                for a in [
+                    a
+                    for a, t in self._stale_pushback.items()
+                    if now - t > ttl
+                ]:
+                    del self._stale_pushback[a]
+            for addr in stale_addrs:
+                if now - self._stale_pushback.get(addr, 0.0) < 2.0:
+                    continue
+                self._stale_pushback[addr] = now
+                for peer in self.membership.neighbors():
+                    self.send_to(peer, wire.disconnect_msg(addr))
             target = self.membership.second_link_target()
             if target is not None:
                 self.send_to(target, wire.connect_msg(self.id))
@@ -471,6 +509,7 @@ class P2PNode:
         logger.info("P2P node %s listening on %s:%s", self.id, self.host, self.port)
         last_anchor_try = 0.0
         last_gossip = 0.0
+        last_anti_entropy = time.monotonic()
         while not self.shutdown_flag:
             try:
                 # Periodic stats gossip. The reference only gossips on events
@@ -484,15 +523,38 @@ class P2PNode:
                 ):
                     self.broadcast_stats()
                     last_gossip = time.monotonic()
-                # retry the anchor until the join took (the reference blocks
-                # forever if the anchor isn't up yet, node.py:559-568)
+                # periodic anti-entropy: re-flood the membership view even
+                # without a change, so a node that MISSED a deletion/join
+                # flood (lossy wire) converges within a bounded window —
+                # its stale re-flood also triggers the tombstone pushback
                 if (
-                    self.anchor_node
-                    and not self.membership.neighbors()
+                    time.monotonic() - last_anti_entropy > ANTI_ENTROPY_S
+                    and self.membership.neighbors()
+                ):
+                    self.broadcast_all_peers()
+                    last_anti_entropy = time.monotonic()
+                # retry the anchor until the join took (the reference blocks
+                # forever if the anchor isn't up yet, node.py:559-568); a
+                # node with NO anchor (the original anchor itself) re-dials
+                # remembered peers instead — churn can orphan it when every
+                # neighbor dies, and the reference's peers_to_reconnect is
+                # populated but never dialed from (SURVEY.md §5)
+                if (
+                    not self.membership.neighbors()
                     and time.monotonic() - last_anchor_try > 2.0
                 ):
-                    self.connect_to_anchor_node()
-                    last_anchor_try = time.monotonic()
+                    if self.anchor_node:
+                        self.connect_to_anchor_node()
+                        last_anchor_try = time.monotonic()
+                    else:
+                        target = self.membership.reconnect_candidate()
+                        if target is not None:
+                            logger.info(
+                                "orphaned: re-dialing remembered peer %s",
+                                target,
+                            )
+                            self.send_to(target, wire.connect_msg(self.id))
+                            last_anchor_try = time.monotonic()
                 self._reap_dead_neighbors()
                 payload, _ = self.recv()
                 if payload is None:

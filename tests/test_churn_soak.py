@@ -1,0 +1,253 @@
+"""Randomized membership-churn soak (VERDICT r4 task 7).
+
+The single-event failure paths are covered in test_net_failure.py /
+test_faults.py; this soak composes them: a seeded random sequence of
+join / graceful-leave / SIGKILL events over a cluster whose wire is
+simultaneously lossy (utils.faults.FaultInjector drop/delay/duplicate),
+then asserts the two properties the reference verifiably lacks
+(SURVEY.md §3.5 [verified live]):
+
+  1. the survivors' ``/network`` views converge on exactly the surviving
+     membership — deletions propagate (the reference's grow-only union
+     leaks dead peers forever, reference node.py:227-231), and
+  2. a farmed solve through a random survivor completes correctly even
+     with dispatch/answer datagrams being dropped and a worker crashing
+     mid-solve — no farmed cell is ever lost (task deadlines + requeue;
+     the reference returns boards with holes, reference node.py:462-464).
+
+Deterministic per seed: every random choice (event sequence, victims,
+fault plans) derives from the seed.
+"""
+
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    oracle_is_valid_solution,
+)
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.utils import FaultInjector
+
+
+def free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1,))
+    eng.warmup()
+    return eng
+
+
+def _lossy_injector(seed: int) -> FaultInjector:
+    # Task dispatch/answers and the gossip heartbeat all lossy; membership
+    # floods delayed (reordered) but not dropped — the flood re-sends only
+    # on merge *change*, so a silently eaten flood has no retry transport
+    # and convergence would hinge on unrelated later churn. Delay still
+    # exercises the reordering the real network can produce.
+    return FaultInjector(
+        drop={"solve": 0.15, "solution": 0.15, "stats": 0.15},
+        delay_s={"all_peers": 0.05},
+        duplicate={"stats": 0.2, "solution": 0.2},
+        seed=seed,
+    )
+
+
+class Soak:
+    def __init__(self, engine, seed: int, n_start: int = 4):
+        self.engine = engine
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.nodes: list[P2PNode] = []
+        self.alive: list[P2PNode] = []
+        self.anchor = None
+        for _ in range(n_start):
+            self.join()
+
+    def join(self):
+        port = free_port()
+        anchor = (
+            self.rng.choice(self.alive).id if self.alive else None
+        )
+        node = P2PNode(
+            "127.0.0.1",
+            port,
+            anchor_node=anchor,
+            handicap=0.0,
+            engine=self.engine,
+            failure_timeout=2.0,
+            fault_injector=_lossy_injector(self.rng.randrange(1 << 30)),
+        )
+        threading.Thread(target=node.run, daemon=True).start()
+        self.nodes.append(node)
+        self.alive.append(node)
+        return node
+
+    def graceful_leave(self):
+        victim = self.rng.choice(self.alive[1:])  # keep index 0 stable
+        self.alive.remove(victim)
+        victim.shutdown()
+
+    def crash(self):
+        victim = self.rng.choice(self.alive[1:])
+        self.alive.remove(victim)
+        victim.shutdown_flag = True  # SIGKILL-equivalent: no disconnect
+        victim.sock.close()
+
+    def wait_converged(self, timeout=30.0):
+        want = {n.id for n in self.alive}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            views = [
+                set(n.membership.total_peers()) | {n.id} for n in self.alive
+            ]
+            if all(v == want for v in views):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def stop(self):
+        for n in self.alive:
+            n.shutdown()
+
+
+def test_same_address_rejoin_heals_within_ttl(engine):
+    """A node that dies and REJOINS WITH ITS OLD ADDRESS inside the
+    tombstone TTL must durably re-enter the membership — the pushback
+    relays must not renew each other's tombstones forever (the livelock
+    code-review r5 flagged: tombstones renew only when a disconnect
+    actually changes the holder's view, so un-renewed tombstones expire
+    and the rejoin merges everywhere within ~one TTL)."""
+    ttl = 2.0
+    nodes = []
+    anchor = None
+    ports = [free_port() for _ in range(3)]
+    for port in ports:
+        node = P2PNode(
+            "127.0.0.1", port, anchor_node=anchor, handicap=0.0,
+            engine=engine, failure_timeout=1.5, tombstone_ttl_s=ttl,
+        )
+        if anchor is None:
+            anchor = f"127.0.0.1:{port}"
+        threading.Thread(target=node.run, daemon=True).start()
+        nodes.append(node)
+    try:
+        want = {n.id for n in nodes}
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(
+                set(n.membership.total_peers()) | {n.id} == want
+                for n in nodes
+            ):
+                break
+            time.sleep(0.05)
+
+        # crash the last joiner; survivors prune + tombstone it
+        victim = nodes[2]
+        victim_port = ports[2]
+        victim.shutdown_flag = True
+        victim.sock.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(
+                victim.id not in n.membership.total_peers()
+                for n in nodes[:2]
+            ):
+                break
+            time.sleep(0.05)
+
+        # rejoin with the SAME address while the tombstones are live
+        reborn = P2PNode(
+            "127.0.0.1", victim_port, anchor_node=anchor, handicap=0.0,
+            engine=engine, failure_timeout=1.5, tombstone_ttl_s=ttl,
+        )
+        threading.Thread(target=reborn.run, daemon=True).start()
+        nodes[2] = reborn
+        deadline = time.monotonic() + ttl + 15
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            ok = all(
+                set(n.membership.total_peers()) | {n.id} == want
+                for n in nodes
+            )
+            time.sleep(0.1)
+        assert ok, [n.membership.all_peers for n in nodes]
+    finally:
+        for n in nodes:
+            if not n.shutdown_flag:
+                n.shutdown()
+
+
+@pytest.mark.parametrize("seed", [11, 23, 37])
+def test_membership_churn_soak(engine, seed):
+    soak = Soak(engine, seed)
+    try:
+        assert soak.wait_converged(), (
+            f"seed {seed}: initial 4-node convergence failed: "
+            f"{[n.membership.all_peers for n in soak.alive]}"
+        )
+
+        # 6 churn events; keep ≥3 alive so the final farm has ≥2 workers
+        for _ in range(6):
+            if len(soak.alive) <= 3:
+                event = "join"
+            else:
+                event = soak.rng.choice(["join", "graceful", "crash"])
+            if event == "join":
+                soak.join()
+            elif event == "graceful":
+                soak.graceful_leave()
+            else:
+                soak.crash()
+            time.sleep(soak.rng.uniform(0.1, 0.8))
+
+        # 1) deletions + additions all propagated to every survivor
+        assert soak.wait_converged(), (
+            f"seed {seed}: post-churn convergence failed: alive="
+            f"{[n.id for n in soak.alive]} views="
+            f"{[n.membership.all_peers for n in soak.alive]}"
+        )
+
+        # 2) a farmed solve through a random survivor completes correctly
+        # under the lossy wire, with one more worker crashing mid-solve
+        master = soak.rng.choice(soak.alive)
+        board = generate_batch(1, 25, seed=seed, unique=True)[0].tolist()
+        victims = [n for n in soak.alive if n is not master]
+        mid_victim = soak.rng.choice(victims)
+        killer = threading.Timer(
+            0.05,
+            lambda: (
+                soak.alive.remove(mid_victim),
+                setattr(mid_victim, "shutdown_flag", True),
+                mid_victim.sock.close(),
+            ),
+        )
+        killer.start()
+        try:
+            solution = master.peer_sudoku_solve(board)
+        finally:
+            killer.cancel()
+            killer.join(timeout=5)
+        assert solution is not None, f"seed {seed}: farmed solve failed"
+        assert all(v != 0 for row in solution for v in row), (
+            f"seed {seed}: farmed solve returned an incomplete board"
+        )
+        assert oracle_is_valid_solution(solution)
+        # clue preservation: the solve answered THIS board
+        for i in range(9):
+            for j in range(9):
+                if board[i][j]:
+                    assert solution[i][j] == board[i][j]
+    finally:
+        soak.stop()

@@ -59,12 +59,46 @@ def test_disconnect_child_removes_empty_parent():
     assert m.network_view() == {A: []}
 
 
-def test_liveness_flag_revived_on_resight():
+def test_orphan_redial_never_targets_self_or_departed():
+    """verify r5: when a node's parent dies while the node's own id is an
+    all_peers KEY (someone's second-link flood records us as a parent),
+    the redial pick must skip ourselves and the departed peer — a
+    self-dial handshakes with ourselves and writes a {self: [self]} loop
+    into every /network view."""
+    m = Membership(B)
+    m.on_connected(A)
+    m.merge_all_peers({A: [B, C], B: [A]})
+    changed, redial = m.on_disconnect(A)
+    assert changed
+    assert redial == C  # not B (self), not A (departed)
+
+    # nobody else known: no redial rather than a self-dial
+    m2 = Membership(B)
+    m2.on_connected(A)
+    m2.merge_all_peers({A: [B], B: [A]})
+    _, redial2 = m2.on_disconnect(A)
+    assert redial2 is None
+
+
+def test_liveness_flag_revived_on_direct_contact_not_stale_flood():
+    """Round-5 churn-soak semantics: a flood naming a tombstoned peer no
+    longer revives it (that is the resurrection race — a stale pre-death
+    view would re-add the dead peer network-wide); instead the address is
+    queued for disconnect pushback. DIRECT evidence of life (a datagram
+    from the peer → mark_alive, or a live dial → on_connect) clears the
+    tombstone, after which floods merge it again."""
     m = Membership(C)
     m.merge_all_peers({A: [B]})
     m.on_disconnect(B)
     assert m.peers_to_reconnect[B] is False
-    m.merge_all_peers({A: [B]})
+    # stale flood: filtered, not merged, recorded for pushback
+    assert m.merge_all_peers({A: [B]}) is False
+    assert m.peers_to_reconnect[B] is False
+    assert m.drain_stale() == [B]
+    assert m.drain_stale() == []  # drained once
+    # direct contact heals: tombstone cleared, the next flood merges
+    m.mark_alive(B)
+    assert m.merge_all_peers({A: [B]}) is True
     assert m.peers_to_reconnect[B] is True
 
 
