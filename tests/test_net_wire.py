@@ -166,11 +166,24 @@ def test_captured_stats_golden():
 
 
 def test_captured_disconnect_golden():
-    # graceful shutdown, idle (reference node.py:652); the mid-task
-    # row/col variant is pinned from source in test_disconnect_bytes —
-    # staging a capture requires killing the reference mid-dispatch
+    # graceful shutdown, idle (reference node.py:652)
     captured = b'{"type": "disconnect", "address": "127.0.0.1:7961"}'
     assert wire.encode_msg(wire.disconnect_msg("127.0.0.1:7961")) == captured
+
+
+def test_captured_disconnect_mid_task_golden():
+    # graceful shutdown while a cell task is in flight: the reference
+    # appends the task's row/col so the master can requeue it (reference
+    # node.py:654). Captured 2026-07-31 by SIGINTing a worker mid-probe
+    # (capture harness scenario E: a row holding 1..8 makes the greedy
+    # probe pay ~9 throttled full-board checks under -h 100, leaving
+    # seconds of mid-task window).
+    captured = (
+        b'{"type": "disconnect", "address": "127.0.0.1:7962", '
+        b'"row": 4, "col": 8}'
+    )
+    msg = wire.disconnect_msg("127.0.0.1:7962", (4, 8))
+    assert wire.encode_msg(msg) == captured
 
 
 def test_roundtrip():

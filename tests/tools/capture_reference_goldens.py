@@ -15,6 +15,15 @@ reference is available to regenerate the goldens:
 
 It is NOT part of the CI suite (the suite must pass without the reference
 checkout present).
+
+The disconnect-with-task variant (reference node.py:654) is scenario E
+below: a second reference worker started with a large handicap
+(``-h 100``) is dispatched a "solve" whose row already holds 1..8 — the
+greedy probe then pays ~9 throttled full-board checks, leaving seconds of
+mid-task window — and is SIGINTed mid-probe; its shutdown broadcast then
+carries the in-flight row/col:
+
+    {"type": "disconnect", "address": "...", "row": 4, "col": 8}
 """
 
 import json
@@ -157,6 +166,45 @@ def main(ref_dir: str) -> None:
         ref.send_signal(signal.SIGINT)
         record(recv_all(fake, n=4, timeout=10.0))
         ref.wait(timeout=10)
+
+        # ---- scenario E: SIGINT mid-task → disconnect with row/col --------
+        # (reference node.py:654; see module docstring for the staging)
+        ref2 = subprocess.Popen(
+            [sys.executable, str(tmp / "node.py"),
+             "-p", "8962", "-s", "7962", "-a", fake_id, "-h", "100"],
+            cwd=tmp, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            recv_all(fake, n=1, timeout=15.0)  # its connect
+            fake.sendto(
+                json.dumps(
+                    {"type": "connected", "address": fake_id}
+                ).encode(),
+                ("127.0.0.1", 7962),
+            )
+            recv_all(fake, n=4, timeout=2.0)  # drain join traffic
+            slow = [[0] * 9 for _ in range(9)]
+            slow[4][:8] = [1, 2, 3, 4, 5, 6, 7, 8]  # probe must try 9 values
+            fake.sendto(
+                json.dumps(
+                    {
+                        "type": "solve",
+                        "sudoku": slow,
+                        "row": 4,
+                        "col": 8,
+                        "address": fake_id,
+                    }
+                ).encode(),
+                ("127.0.0.1", 7962),
+            )
+            time.sleep(3.0)  # well inside the throttled probe
+            ref2.send_signal(signal.SIGINT)
+            record(recv_all(fake, n=4, timeout=12.0))
+            ref2.wait(timeout=15)
+        finally:
+            if ref2.poll() is None:
+                ref2.kill()
+                ref2.wait()
     finally:
         if ref.poll() is None:
             ref.kill()
