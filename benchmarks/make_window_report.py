@@ -1,0 +1,105 @@
+"""Summarize the round's TPU claim attempts into one machine-readable
+artifact (VERDICT r4 task 1's fallback deliverable: "a session artifact
+proving N attempts with captured per-attempt error detail").
+
+Parses ``tpu_session_r5.log`` (wrapper attempt markers + window
+open/close transitions) and ``tpu_session_r5.jsonl`` (per-phase emits,
+init/phase diagnostics) into ``window_report_r5.json``.
+
+Run any time; idempotent:  python benchmarks/make_window_report.py
+"""
+
+import json
+import os
+import re
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(HERE, "tpu_session_r5.log")
+JSONL = os.path.join(HERE, "tpu_session_r5.jsonl")
+OUT = os.path.join(HERE, "window_report_r5.json")
+
+
+def main():
+    attempts = []
+    windows = []
+    cur = None
+    for line in open(LOG, errors="replace"):
+        m = re.match(r"=== attempt (\d+) (\d\d:\d\d:\d\d) ===", line)
+        if m:
+            cur = {"attempt": int(m.group(1)), "start_utc": m.group(2)}
+            attempts.append(cur)
+            continue
+        m = re.match(
+            r"=== attempt (\d+) exited rc=(\d+) after (\d+)s (\d\d:\d\d:\d\d)",
+            line,
+        )
+        if m and cur and cur["attempt"] == int(m.group(1)):
+            cur.update(
+                rc=int(m.group(2)),
+                duration_s=int(m.group(3)),
+                end_utc=m.group(4),
+            )
+            continue
+        m = re.match(r"=== window (OPEN|CLOSED)[^=]*?(\d\d:\d\d:\d\d)", line)
+        if m:
+            windows.append({"state": m.group(1), "utc": m.group(2)})
+
+    phases = []
+    for line in open(JSONL, errors="replace"):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("phase") in (
+            "backend_up",
+            "init_timeout",
+            "init_error",
+            "phase_timeout",
+            "error",
+            "measure",
+            "artifact",
+            "pallas_result",
+            "pallas_error",
+            "done",
+        ):
+            phases.append(rec)
+
+    # derive the narrative from the parsed records so a re-run never
+    # contradicts its own data (code-review r5)
+    inits = [p for p in phases if p["phase"] == "backend_up"]
+    fails = [
+        p
+        for p in phases
+        if p["phase"] in ("init_timeout", "init_error", "phase_timeout", "error")
+    ]
+    measures = [p for p in phases if p["phase"] == "measure"]
+    done = [p for p in phases if p["phase"] == "done"]
+    notes = (
+        "axon terminal services are relay-forwarded local ports (8082 "
+        "claim/init, 8093 remote_compile) that open and close; the "
+        "wrapper scans both and launches only on open windows. "
+        f"{len(attempts)} attempt(s): {len(inits)} reached backend_up, "
+        f"{len(measures)} landed measurements, {len(fails)} recorded "
+        f"failure diagnostics (detail in session_events). "
+        + ("Session finished." if done else "Session/scan still running.")
+    )
+    report = {
+        "round": 5,
+        "generated_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "attempts": attempts,
+        "n_attempts": len(attempts),
+        "window_transitions": windows,
+        "session_events": phases,
+        "notes": notes,
+    }
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+    print(
+        f"wrote {OUT}: {len(attempts)} attempts, "
+        f"{len(windows)} window transitions, {len(phases)} session events"
+    )
+
+
+if __name__ == "__main__":
+    main()

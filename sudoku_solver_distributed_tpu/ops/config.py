@@ -75,6 +75,9 @@ def serving_config(size: int) -> dict:
 # labeled CPU-fallback path: the headline metric must measure the config
 # the TPU serving engine actually runs, but a `*_cpu_fallback` record
 # should report the CPU backend at its honest best, stated in the record.
+# (Re-swept 2026-07-31 on the 4096-board corpus: waves=1+locked at
+# 7,339/s beats no-locked 4,760, pairs 3,033, waves=2 5,781, light-wave
+# variants <=6,307, flat depth 5,800 — the override below stands.)
 CPU_SERVING_OVERRIDES = {
     9: dict(waves=1),
     16: dict(),

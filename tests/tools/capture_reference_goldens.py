@@ -217,6 +217,15 @@ def main(ref_dir: str) -> None:
         for i, p in enumerate(payloads):
             print(f"CAPTURED {t}[{i}] = {p!r}")
 
+    # scenario E races a fixed sleep against the throttled probe; if the
+    # worker finished first the mid-task variant is silently missing —
+    # fail loudly instead of letting a maintainer pin wrong goldens
+    if not any(b'"row"' in p for p in captured.get("disconnect", [])):
+        sys.exit(
+            "scenario E lost the mid-probe race: no disconnect-with-task "
+            "datagram captured (raise the sleep or the handicap and re-run)"
+        )
+
 
 if __name__ == "__main__":
     main(sys.argv[1] if len(sys.argv) > 1 else "/root/reference")
