@@ -6,7 +6,7 @@ Parses ``tpu_session_r5.log`` (wrapper attempt markers + window
 open/close transitions) and ``tpu_session_r5.jsonl`` (per-phase emits,
 init/phase diagnostics) into ``window_report_r5.json``.
 
-Run any time; idempotent:  python benchmarks/make_window_report.py
+Run any time; idempotent:  python benchmarks/make_window_report.py [round]
 """
 
 import json
@@ -15,9 +15,14 @@ import re
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-LOG = os.path.join(HERE, "tpu_session_r5.log")
-JSONL = os.path.join(HERE, "tpu_session_r5.jsonl")
-OUT = os.path.join(HERE, "window_report_r5.json")
+# round number as argv[1] (default 5) so next round reuses this parser
+# instead of forking an _r6 copy (code-review r5)
+import sys
+
+ROUND = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+LOG = os.path.join(HERE, f"tpu_session_r{ROUND}.log")
+JSONL = os.path.join(HERE, f"tpu_session_r{ROUND}.jsonl")
+OUT = os.path.join(HERE, f"window_report_r{ROUND}.json")
 
 
 def main():
@@ -85,7 +90,7 @@ def main():
         + ("Session finished." if done else "Session/scan still running.")
     )
     report = {
-        "round": 5,
+        "round": ROUND,
         "generated_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
         "attempts": attempts,
         "n_attempts": len(attempts),

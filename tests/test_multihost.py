@@ -362,11 +362,14 @@ def test_follower_death_outside_collective_degrades_not_hangs(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_cli_frontier_serving_loop():
+@pytest.mark.parametrize("n_hosts", [2, 3])
+def test_cli_frontier_serving_loop(n_hosts):
     """--frontier in multi-host mode: every host enters the collective
     frontier race in lockstep through the SPMD serving loop
     (parallel/serving_loop.py), and the leader's HTTP /solve serves the
-    README 8-clue board from it."""
+    README 8-clue board from it. Parametrized over host count: the loop
+    and mesh construction must be host-count-agnostic (3 hosts = leader
+    + 2 followers following the same broadcast)."""
     import json
     import time
     import urllib.request
@@ -383,31 +386,32 @@ def test_two_process_cli_frontier_serving_loop():
     )
     env.pop("PALLAS_AXON_POOL_IPS", None)
 
-    http0, http1 = _free_tcp_port(), _free_tcp_port()
-    udp0, udp1 = _free_tcp_port(), _free_tcp_port()
+    https = [_free_tcp_port() for _ in range(n_hosts)]
+    udps = [_free_tcp_port() for _ in range(n_hosts)]
+    http0 = https[0]
     common = ["-h", "0", "--buckets", "1",
               "--frontier", "4", "--frontier-route", "always",
-              "--coordinator", coord, "--num-hosts", "2"]
+              "--coordinator", coord, "--num-hosts", str(n_hosts)]
     import tempfile
 
-    host1_log = tempfile.NamedTemporaryFile(
+    last_follower_log = tempfile.NamedTemporaryFile(
         mode="w+", suffix=".log", delete=False
     )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "node.py"),
-             "-p", str(http0), "-s", str(udp0), "--host-id", "0"] + common,
-            env=env, cwd=REPO,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        ),
-        subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "node.py"),
-             "-p", str(http1), "-s", str(udp1), "--host-id", "1",
-             "-a", f"127.0.0.1:{udp0}"] + common,
-            env=env, cwd=REPO,
-            stdout=subprocess.DEVNULL, stderr=host1_log,
-        ),
-    ]
+    procs = []
+    for k in range(n_hosts):
+        cmd = [sys.executable, os.path.join(REPO, "node.py"),
+               "-p", str(https[k]), "-s", str(udps[k]),
+               "--host-id", str(k)] + common
+        if k > 0:
+            cmd += ["-a", f"127.0.0.1:{udps[0]}"]
+        procs.append(
+            subprocess.Popen(
+                cmd, env=env, cwd=REPO,
+                stdout=subprocess.DEVNULL,
+                # the LAST follower's log proves followers raced the request
+                stderr=last_follower_log if k == n_hosts - 1 else subprocess.DEVNULL,
+            )
+        )
     try:
         deadline = time.time() + 240
         while time.time() < deadline:
@@ -446,11 +450,11 @@ def test_two_process_cli_frontier_serving_loop():
                 if readme[i][j]:
                     assert solution[i][j] == readme[i][j]
         assert all(p.poll() is None for p in procs), "a host crashed"
-        # host 1 entered the collective race for the REQUEST too, not just
-        # the start() warmup — proves the loop serves /solve (an 8-clue
-        # line beyond the warmup's 0-clue one)
-        host1_log.flush()
-        with open(host1_log.name) as f:
+        # the last follower entered the collective race for the REQUEST
+        # too, not just the start() warmup — proves the loop serves /solve
+        # (an 8-clue line beyond the warmup's 0-clue one)
+        last_follower_log.flush()
+        with open(last_follower_log.name) as f:
             races = [
                 line for line in f
                 if "frontier serving loop: racing a board" in line
@@ -465,4 +469,4 @@ def test_two_process_cli_frontier_serving_loop():
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
-        os.unlink(host1_log.name)
+        os.unlink(last_follower_log.name)
