@@ -1,0 +1,99 @@
+"""Probe whether client-side AOT compilation works against the terminal.
+
+The axon platform's normal path compiles terminal-side via
+``POST 127.0.0.1:8093/remote_compile`` — a relay-forwarded port that is
+frequently closed (round-5 discovery; benchmarks/tpu_session_r5.log). The
+plugin also supports ``remote_compile=False``: compile LOCALLY with the
+pip-installed libtpu and only execute on the terminal — no 8093
+dependency at all. Round 2 found the terminal refused such programs on a
+libtpu build mismatch (terminal Nov 2025 vs client Jan 2026); this probe
+retests that cheaply each claim window, because the infra has visibly
+churned since and a healed mismatch would unlock the whole measurement
+session without the flaky compile relay.
+
+MUST be launched with ``PALLAS_AXON_REMOTE_COMPILE=0`` in the
+environment (the sitecustomize reads it at interpreter start; setting it
+after import is a no-op). The wrapper does this.
+
+Appends one JSON line to tpu_session_r5.jsonl:
+  {"phase": "aot_probe_ok", ...}      — local compile + on-chip run WORKED
+  {"phase": "aot_probe_error", ...}   — the refusal/diagnostic detail
+Exit 0 on success, 3 otherwise.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "tpu_session_r5.jsonl")
+
+
+def emit(record):
+    record["t"] = round(time.time(), 1)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+    print("EMIT", json.dumps(record), flush=True)
+
+
+def main():
+    if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") != "0":
+        emit(
+            {
+                "phase": "aot_probe_error",
+                "err": "launched without PALLAS_AXON_REMOTE_COMPILE=0 — "
+                "the sitecustomize already registered remote-compile",
+            }
+        )
+        os._exit(3)
+
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(240):
+            emit(
+                {
+                    "phase": "aot_probe_error",
+                    "err": "probe exceeded 240s (init or run hang)",
+                }
+            )
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    try:
+        import jax
+
+        devs = jax.devices()
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        out = jax.jit(lambda x: (x @ x).sum())(
+            jnp.ones((128, 128), jnp.bfloat16)
+        )
+        val = float(out)
+        emit(
+            {
+                "phase": "aot_probe_ok",
+                "platform": devs[0].platform,
+                "compile_run_s": round(time.perf_counter() - t0, 2),
+                "result": val,
+                "detail": "local AOT compile executed on the terminal — "
+                "the session can run with PALLAS_AXON_REMOTE_COMPILE=0",
+            }
+        )
+        done.set()
+        sys.exit(0)
+    except Exception as e:  # noqa: BLE001 — the diagnostic IS the point
+        # (not BaseException: the success path's SystemExit(0) must
+        # propagate, not be re-reported as failure — code-review r5)
+        emit({"phase": "aot_probe_error", "err": repr(e)[:800]})
+        done.set()
+        os._exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -30,9 +30,13 @@ def main():
     windows = []
     cur = None
     for line in open(LOG, errors="replace"):
-        m = re.match(r"=== attempt (\d+) (\d\d:\d\d:\d\d) ===", line)
+        m = re.match(
+            r"=== attempt (\d+)(?: \(([\w-]+)\))? (\d\d:\d\d:\d\d) ===", line
+        )
         if m:
-            cur = {"attempt": int(m.group(1)), "start_utc": m.group(2)}
+            cur = {"attempt": int(m.group(1)), "start_utc": m.group(3)}
+            if m.group(2):
+                cur["mode"] = m.group(2)  # AOT | remote-compile
             attempts.append(cur)
             continue
         m = re.match(
