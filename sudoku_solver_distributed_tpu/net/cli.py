@@ -18,6 +18,10 @@ Extensions (defaults preserve reference behavior):
   --no-warmup   skip engine pre-compilation (faster start, slower first solve)
   --metrics     expose GET /metrics (per-route latency percentiles); off by
                 default so the unknown-path 404 surface stays byte-identical
+  --batch-api   expose POST /solve_batch — many boards per request through
+                the engine's bucketed batch path (the bench.py throughput
+                strength on the serving surface); off by default, same
+                404-parity reason
   --profile-dir write a jax.profiler device trace of each /solve to this dir
   --failure-timeout
                 seconds of neighbor silence before a crash is declared (the
@@ -69,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--metrics", action="store_true", help="expose GET /metrics"
+    )
+    parser.add_argument(
+        "--batch-api",
+        action="store_true",
+        help="expose POST /solve_batch (the engine's bucketed batch path "
+        "over HTTP; opt-in — off keeps the reference 404 surface)",
     )
     parser.add_argument(
         "--profile-dir", default=None, help="jax.profiler trace output dir"
@@ -232,7 +242,9 @@ def main(argv=None) -> None:
         threading.Thread(target=node.engine.warmup, daemon=True).start()
 
     httpd = make_http_server(
-        node, args.host, args.p, expose_metrics=args.metrics
+        node, args.host, args.p,
+        expose_metrics=args.metrics,
+        expose_batch=args.batch_api,
     )
     http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     http_thread.start()

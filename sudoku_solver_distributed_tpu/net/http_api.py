@@ -47,6 +47,10 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
     p2p_node = None       # set by make_http_server
     expose_metrics = False  # opt-in /metrics route (CLI --metrics); default
     #                         off keeps the 404 surface byte-identical
+    expose_batch = False    # opt-in POST /solve_batch (CLI --batch-api):
+    #                         the engine's bucketed batch path through HTTP
+    MAX_BATCH = 4096        # board-count guard for /solve_batch
+    MAX_BATCH_BYTES = 32 << 20  # body-size guard, checked before buffering
 
     def _send_response(self, content, status: int = 200) -> None:
         body = json.dumps(content).encode()
@@ -69,7 +73,10 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
                 content_length = int(self.headers.get("Content-Length", 0))
                 post_data = self.rfile.read(content_length)
                 sudoku = json.loads(post_data.decode("utf-8"))["sudoku"]
-            except (ValueError, KeyError, UnicodeDecodeError):
+            except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+                # TypeError: a JSON-valid non-object body ([1,2,3], "foo")
+                # makes body["sudoku"] a non-subscript access — same 400,
+                # never a dead handler thread (code-review r5).
                 # record before replying: a client may poll /metrics the
                 # instant its response arrives
                 self._record("/solve", t0, error=True)
@@ -92,6 +99,61 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
                 self._send_response(
                     {"error": "No solution found", "solution": solution}, 400
                 )
+        elif self.path == "/solve_batch" and self.expose_batch:
+            # Opt-in extension (not a reference surface): the engine's
+            # bucketed batch path over HTTP — the framework's headline
+            # strength (bench.py throughput) reachable by a serving
+            # client, instead of one board per request. Body:
+            # {"sudokus": [grid, ...]} → {"solutions": [grid|null, ...],
+            # "solved": n, "capped": n}. null rows mean not solved;
+            # capped counts rows whose search exhausted the iteration
+            # budget (not finished ≠ proven unsatisfiable, engine.py).
+            try:
+                content_length = int(self.headers.get("Content-Length", 0))
+                if content_length > self.MAX_BATCH_BYTES:
+                    # bound memory BEFORE buffering the body: a batch
+                    # endpoint invites large payloads (code-review r5);
+                    # 4096 25x25 boards serialize to ~8 MB, so the cap
+                    # is generous for every legitimate request
+                    self._record("/solve_batch", t0, error=True)
+                    self._send_response({"error": "Invalid request"}, 400)
+                    return
+                body = json.loads(self.rfile.read(content_length).decode())
+                sudokus = body["sudokus"]
+            except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+                self._record("/solve_batch", t0, error=True)
+                self._send_response({"error": "Invalid request"}, 400)
+                return
+            size = self.p2p_node.engine.spec.size
+            if (
+                not isinstance(sudokus, list)
+                or not 1 <= len(sudokus) <= self.MAX_BATCH
+            ):
+                reason = f"need 1..{self.MAX_BATCH} boards"
+            else:
+                reason = next(
+                    filter(
+                        None, (_board_error(s, size) for s in sudokus)
+                    ),
+                    None,
+                )
+            if reason is not None:
+                logger.info("rejected /solve_batch body: %s", reason)
+                self._record("/solve_batch", t0, error=True)
+                self._send_response({"error": "Invalid request"}, 400)
+                return
+            solutions, mask, info = self.p2p_node.batch_sudoku_solve(sudokus)
+            self._record("/solve_batch", t0)
+            self._send_response(
+                {
+                    "solutions": [
+                        sol.tolist() if ok else None
+                        for sol, ok in zip(solutions, mask)
+                    ],
+                    "solved": int(mask.sum()),
+                    "capped": info["capped"],
+                }
+            )
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)
 
@@ -118,12 +180,21 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
 
 
 def make_http_server(
-    p2p_node, host: str, http_port: int, *, expose_metrics: bool = False
+    p2p_node,
+    host: str,
+    http_port: int,
+    *,
+    expose_metrics: bool = False,
+    expose_batch: bool = False,
 ) -> ThreadingHTTPServer:
     handler = type(
         "BoundHandler",
         (SudokuHTTPHandler,),
-        {"p2p_node": p2p_node, "expose_metrics": expose_metrics},
+        {
+            "p2p_node": p2p_node,
+            "expose_metrics": expose_metrics,
+            "expose_batch": expose_batch,
+        },
     )
     httpd = ThreadingHTTPServer((host, http_port), handler)
     logger.info("HTTP server on %s:%s", host, http_port)

@@ -392,6 +392,19 @@ class P2PNode:
             self.broadcast_stats()
             return solution
 
+    def batch_sudoku_solve(self, sudokus):
+        """Solve many boards in one engine batch (the opt-in
+        POST /solve_batch extension, http_api.py). Counters and stats
+        gossip behave exactly as len(sudokus) sequential solves would:
+        solved boards add to this node's solved count, the engine bills
+        its validation sweeps, and one stats broadcast follows."""
+        with self._solve_lock:
+            # solve_batch_np owns the int32 conversion (engine.py)
+            solutions, mask, info = self.engine.solve_batch_np(sudokus)
+            self._solved_count += int(mask.sum())
+            self.broadcast_stats()
+            return solutions, mask, info
+
     def _farm_solve(self, sudoku, peers: List[str]) -> Optional[list]:
         board = [list(r) for r in sudoku]
         with self._state_lock:

@@ -298,6 +298,98 @@ def test_http_solve_semantic_validation(engine):
         c.stop()
 
 
+def test_http_solve_batch_opt_in(engine):
+    """POST /solve_batch (opt-in --batch-api): many boards through the
+    engine's bucketed batch path in one request; 404 when not enabled
+    (reference surface parity); 400 on malformed bodies; unsolved rows
+    are null; stats count the batch like sequential solves."""
+    c = Cluster(1, engine)
+    httpd = httpd_off = None
+    try:
+        node = c.nodes[0]
+        http_port, off_port = free_port(), free_port()
+        httpd = make_http_server(
+            node, "127.0.0.1", http_port, expose_batch=True
+        )
+        httpd_off = make_http_server(node, "127.0.0.1", off_port)
+        for h in (httpd, httpd_off):
+            threading.Thread(target=h.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{http_port}"
+
+        unsat = [[0] * 9 for _ in range(9)]
+        unsat[0][0] = unsat[0][1] = 5
+        boards = [[[0] * 9 for _ in range(9)], unsat]
+        boards[0][0][0] = 3
+        solved_before = node.solved_puzzles
+
+        req = urllib.request.Request(
+            f"{base}/solve_batch",
+            data=json.dumps({"sudokus": boards}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            out = json.loads(resp.read())
+        assert out["solved"] == 1 and out["capped"] == 0
+        assert out["solutions"][1] is None  # the unsat board
+        assert oracle_is_valid_solution(out["solutions"][0])
+        assert out["solutions"][0][0][0] == 3  # clue preserved
+        assert node.solved_puzzles == solved_before + 1
+
+        # not enabled → byte-identical reference 404
+        req_off = urllib.request.Request(
+            f"http://127.0.0.1:{off_port}/solve_batch",
+            data=json.dumps({"sudokus": boards}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req_off, timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.loads(e.read()) == {"error": "Invalid endpoint"}
+
+        # malformed bodies → 400, never a crash/empty reply
+        for bad in (
+            {"sudokus": []},
+            {"sudokus": "foo"},
+            {"sudokus": [[[0] * 8 for _ in range(8)]]},
+            {"nope": 1},
+            [1, 2, 3],   # JSON-valid non-object body
+            "foo",
+        ):
+            req = urllib.request.Request(
+                f"{base}/solve_batch",
+                data=json.dumps(bad).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, f"expected 400 for {bad!r}"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert json.loads(e.read()) == {"error": "Invalid request"}
+        # oversized Content-Length is rejected before buffering
+        req = urllib.request.Request(
+            f"{base}/solve_batch",
+            data=b"x",
+            headers={
+                "Content-Type": "application/json",
+                "Content-Length": str(64 << 20),
+            },
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 400 for oversized body"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        for h in (httpd, httpd_off):
+            if h is not None:
+                h.shutdown()
+        c.stop()
+
+
 def test_mesh_pseudo_peers(engine):
     port = free_port()
     node = P2PNode("127.0.0.1", port, engine=engine, mesh_peer_count=4)
