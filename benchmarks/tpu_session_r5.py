@@ -66,11 +66,12 @@ PHASE_TIMEOUT_S = float(os.environ.get("TPU_PHASE_TIMEOUT_S", "2400"))
 TARGET_PER_CHIP = 100_000.0  # BASELINE.md 9x9 north star
 
 # Persistent compile cache: a serving-config compile that succeeds ONCE is
-# reused by every later attempt/phase (and by bench.py children pointed at
-# the same dir), so a short claim window is spent measuring, not compiling.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, "benchmarks", ".jax_cache_tpu")
-)
+# reused by every later attempt/phase and by bench.py (which owns the ONE
+# path definition), so a short claim window is spent measuring, not
+# compiling.
+from bench import COMPILE_CACHE_DIR  # noqa: E402 — sys.path set above
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
