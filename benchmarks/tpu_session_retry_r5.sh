@@ -30,9 +30,16 @@ aot_checked=no
 aot=no
 attempt=0
 probe() { (echo >"/dev/tcp/127.0.0.1/$1") 2>/dev/null; }
+STOP_AT=${TPU_SESSION_STOP_AT:-1785502000}
 while true; do
   if grep -q '"phase": "done"' benchmarks/tpu_session_r5.jsonl 2>/dev/null; then
     echo "=== session finished (done marker) $(date -u +%H:%M:%S) ===" >> "$LOG"
+    exit 0
+  fi
+  if [ "$(date +%s)" -ge "$STOP_AT" ]; then
+    # hard deadline even if no window ever opened: the scan must not
+    # contend with the driver's own end-of-round bench run
+    echo "=== scanner stopped at deadline $(date -u +%H:%M:%S) ===" >> "$LOG"
     exit 0
   fi
   if probe 8082; then
