@@ -171,6 +171,13 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             eng = getattr(self.p2p_node, "engine", None)
             if eng is not None and hasattr(eng, "health"):
                 body["engine"] = eng.health()
+            # membership churn machinery (tombstones / re-dial pool):
+            # same no-collision argument as the engine block
+            m_health = getattr(
+                getattr(self.p2p_node, "membership", None), "health", None
+            )
+            if m_health is not None:
+                body["membership"] = m_health()
             self._send_response(body)
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)

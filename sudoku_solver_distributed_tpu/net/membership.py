@@ -260,11 +260,16 @@ class Membership:
         """Every known address except ourselves (the task-farm worker pool,
         reference node.py:251-260)."""
         with self._lock:
-            total = set(self.all_peers.keys())
-            for children in self.all_peers.values():
-                total.update(children)
-            total.discard(self.node_id)
-            return sorted(total)
+            return sorted(self._total_peers_locked())
+
+    def _total_peers_locked(self) -> set:
+        """Union of parents and children minus self; callers hold _lock.
+        ONE definition shared by total_peers and health (code-review r5)."""
+        total = set(self.all_peers.keys())
+        for children in self.all_peers.values():
+            total.update(children)
+        total.discard(self.node_id)
+        return total
 
     def network_view(self) -> Dict[str, List[str]]:
         """The GET /network body (reference node.py:696-702)."""
@@ -272,3 +277,19 @@ class Membership:
             if self.all_peers:
                 return {k: list(v) for k, v in self.all_peers.items()}
             return {self.node_id: []}
+
+    def health(self) -> dict:
+        """Operator view of the churn machinery (GET /metrics
+        ``membership`` block): live tombstones mean recent deaths are
+        being held out of flood merges; ``remembered`` is the orphan
+        re-dial pool."""
+        with self._lock:
+            self._purge_tombstones(time.monotonic())
+            return {
+                # distinct peers: a pair that dialed each other lands in
+                # both sets (code-review r5)
+                "neighbors": len(self.peers_in | self.peers_out),
+                "known_peers": len(self._total_peers_locked()),
+                "tombstones": len(self._tombstones),
+                "remembered": len(self.peers_to_reconnect),
+            }

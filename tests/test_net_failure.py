@@ -154,8 +154,16 @@ def test_metrics_endpoint_opt_in(engine):
         # only once a request is recorded
         with urllib.request.urlopen(f"{base_on}/metrics", timeout=5) as r:
             m0 = json.load(r)
-        assert set(m0) == {"engine"}
+        assert set(m0) == {"engine", "membership"}
         assert m0["engine"]["frontier_fallbacks"] == 0
+        # membership churn machinery visibility (round 5): a quiet
+        # single node has no neighbors, no tombstones
+        assert m0["membership"] == {
+            "neighbors": 0,
+            "known_peers": 0,
+            "tombstones": 0,
+            "remembered": 0,
+        }
         req = urllib.request.Request(
             f"{base_on}/solve",
             data=json.dumps({"sudoku": [[0] * 9 for _ in range(9)]}).encode(),
