@@ -36,6 +36,19 @@ Beyond the reference (churn-soak findings, tests/test_churn_soak.py):
     re-dials remembered addresses until the network heals. The reference
     keeps this very structure and never dials from it (SURVEY.md §5).
 
+Tombstone TTL tradeoff (``tombstone_ttl_s``, default 30 s): the TTL
+bounds BOTH how long a same-address rejoin churns against third-party
+tombstones (direct contact heals instantly; distant nodes filter the
+rejoin from floods until their tombstones expire) AND the protection
+window against resurrection — a node stalled/partitioned for longer
+than the TTL while a peer died can re-introduce the dead non-neighbor
+entry via its later floods, after which nothing reaps it (heartbeats
+watch neighbors only). That residual leak is strictly better than the
+reference, which leaks EVERY dead peer in EVERY view permanently
+(SURVEY.md §3.5 [verified live]); deployments with long GC/compile
+stalls should raise the TTL, accepting slower distant-rejoin
+visibility.
+
 The ``all_peers`` dict is the GET /network body — byte-identical shape.
 Thread-safe behind one lock (the reference mutates these sets from two
 threads, unlocked).
