@@ -58,3 +58,22 @@ def test_node_serves_hexadoku(engine16):
     unsat = [[0] * 16 for _ in range(16)]
     unsat[0][0] = unsat[0][1] = 9
     assert node.peer_sudoku_solve(unsat) is None
+
+
+def test_batch_solve_hexadoku(engine16):
+    """The batch path (POST /solve_batch's engine core) is size-generic:
+    16×16 boards solve through the same bucketed kernel, and the board
+    validator enforces the engine's spec size (a 9×9 grid against a 16×16
+    engine is a semantic 400, http_api._board_error)."""
+    from sudoku_solver_distributed_tpu.net.http_api import _board_error
+
+    node = P2PNode("127.0.0.1", 0, engine=engine16, failure_timeout=0.0)
+    boards = generate_batch(4, 100, size=16, seed=63)
+    solutions, mask, info = node.batch_sudoku_solve(boards.tolist())
+    assert mask.all()
+    for sol in solutions:
+        assert oracle_is_valid_solution(sol.tolist())
+    assert node.solved_puzzles == 4
+
+    assert _board_error([[0] * 9 for _ in range(9)], 16) is not None
+    assert _board_error(boards[0].tolist(), 16) is None
