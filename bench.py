@@ -118,10 +118,16 @@ def main():
     )
 
     # Watchdog: on a pooled/tunneled accelerator a stale pool-side claim
-    # makes backend init hang indefinitely (docs/OPERATIONS.md). Fail fast
-    # with a diagnosable message instead of wedging the caller's pipeline.
+    # makes backend init hang indefinitely, and (round-5 discovery) the
+    # FIRST COMPILE can also block unboundedly when the relay's
+    # remote-compile port closes mid-window (docs/OPERATIONS.md). Both
+    # phases fail fast with a diagnosable message instead of wedging the
+    # caller's pipeline into a SIGKILL/parsed:null (the round-3 shape).
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "900"))
+    compile_timeout = float(os.environ.get("BENCH_COMPILE_TIMEOUT_S", "600"))
     init_done = threading.Event()
+    compile_done = threading.Event()
+    compile_armed = threading.Event()
 
     def _watchdog():
         if not init_done.wait(init_timeout):
@@ -130,6 +136,18 @@ def main():
                 f"{init_timeout:.0f}s — pooled-chip claim unavailable "
                 f"(stale claim? see docs/OPERATIONS.md); rerun when the "
                 f"claim frees or set BENCH_PLATFORM=cpu",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(3)
+        compile_armed.wait()
+        if not compile_done.wait(compile_timeout):
+            print(
+                f"# FATAL: first transfer/compile blocked past "
+                f"{compile_timeout:.0f}s — wedged relay (window closed "
+                f"mid-session) or a pathologically slow compile; raise "
+                f"BENCH_COMPILE_TIMEOUT_S if the latter "
+                f"(benchmarks/tpu_session_r5.log)",
                 file=sys.stderr,
                 flush=True,
             )
@@ -206,9 +224,24 @@ def main():
     )
     solve = jax.jit(lambda g: solve_batch(g, spec, **cfg))
 
+    # Transfer + first compile under the compile watchdog: a blocked
+    # device transfer or remote-compile RPC must exit 3 (parent retries /
+    # falls back), not hang into the driver's outer SIGKILL. NOT armed in
+    # the CPU-fallback child (same rule as the init hooks above): the
+    # hazard being guarded is the accelerator relay, and killing a slow
+    # legitimate CPU compile would destroy the guaranteed *_cpu_fallback
+    # record (code-review r5). Exiting mid-compile CAN wedge the pooled
+    # claim (docs/OPERATIONS.md) — but the alternative is the driver's
+    # outer SIGKILL minutes later, which wedges it just the same AND
+    # leaves no parseable artifact; exiting on our own terms records the
+    # diagnostic and lets the parent's next attempt probe the window.
+    if not in_fallback:
+        compile_armed.set()
+        if os.environ.get("BENCH_FAKE_COMPILE_HANG") == "1":
+            time.sleep(compile_timeout * 100)  # test hook: wedged relay
     dev_boards = jnp.asarray(boards)
-    # warm up (compile) once
     res = jax.block_until_ready(solve(dev_boards))
+    compile_done.set()
     assert bool(np.asarray(res.solved).all()), "bench: unsolved boards!"
 
     # Throughput measurement: repeats are dispatched back-to-back (JAX async

@@ -106,6 +106,28 @@ def test_throughput_retry_survives_init_hang(tmp_path):
     assert "attempt 1 failed claim acquisition" in stderr
 
 
+def test_throughput_survives_compile_hang(tmp_path):
+    """Round-5 discovery: the claim window can close MID-SESSION — init
+    succeeds, then the first compile blocks on a dead remote-compile
+    relay. The compile watchdog must exit the child (rc=3) so the parent
+    retries / falls back instead of hanging into the driver's outer
+    SIGKILL (the parsed:null shape)."""
+    artifact, stderr = run_bench(
+        {
+            "BENCH_BATCH": "64",
+            "BENCH_PLATFORM": "cpu",
+            "BENCH_FAKE_COMPILE_HANG": "1",  # every TPU attempt wedges
+            "BENCH_INIT_TIMEOUT_S": "30",
+            "BENCH_COMPILE_TIMEOUT_S": "2",
+            "BENCH_TOTAL_BUDGET_S": "8",
+            "BENCH_RETRY_BACKOFF_S": "0.1",
+        }
+    )
+    assert "transfer/compile blocked past" in stderr
+    check_artifact(artifact)
+    assert artifact["metric"] == "puzzles_per_sec_per_chip_hard9x9_cpu_fallback"
+
+
 def test_throughput_falls_back_to_labeled_cpu_line(tmp_path):
     """VERDICT r3 task 1b: when the claim never frees, the artifact must
     still carry ONE parseable JSON line — a clearly-labeled CPU-fallback
