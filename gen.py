@@ -31,9 +31,47 @@ def generate_sudoku(empty_boxes=0):
 
 
 if __name__ == "__main__":
+    # positional N exactly like the reference; opt-in extensions parsed by
+    # hand so the reference invocation's behavior stays byte-identical:
+    #   --size 16|25   hexadoku / 25x25 (reference hardwires 9, gen.py:6-52)
+    #   --seed S       deterministic generation
+    #   --unique       blank cells only while the puzzle stays single-solution
     empty_boxes = int(sys.argv[1])
+    args = sys.argv[2:]
 
-    new_puzzle = generate_sudoku(empty_boxes)
+    def _usage(msg):
+        sys.exit(
+            f"gen.py: {msg}\nusage: python3 gen.py N "
+            f"[--size 16|25] [--seed S] [--unique]"
+        )
+
+    def _opt(flag, default=None):
+        if flag not in args:
+            return default
+        idx = args.index(flag) + 1
+        if idx >= len(args):
+            _usage(f"{flag} needs a value")
+        try:
+            return int(args[idx])
+        except ValueError:
+            _usage(f"{flag} needs an integer, got {args[idx]!r}")
+
+    size = _opt("--size", 9)
+    seed = _opt("--seed")
+    unique = "--unique" in args
+
+    # early size validation (perfect square) — the generator's diagonal
+    # fill would otherwise die with an opaque IndexError
+    from sudoku_solver_distributed_tpu.ops import spec_for_size
+
+    try:
+        spec_for_size(size)
+    except ValueError as e:
+        _usage(str(e))
+
+    rng = random.Random(seed)
+    board = generate_board(empty_boxes, size=size, rng=rng, unique=unique)
+    new_puzzle = Sudoku(board)
 
     print(new_puzzle)
 
