@@ -77,6 +77,39 @@ def test_concurrent_solves_and_reads(engine):
     assert stats["all"]["solved"] == 8
 
 
+def test_concurrent_single_and_batch_solves(engine):
+    """Mixed /solve and /solve_batch traffic shares the node's solve lock:
+    every result complete and clue-preserving, counters exactly summed
+    (round-5 batch endpoint, net/node.batch_sudoku_solve)."""
+    node = P2PNode("127.0.0.1", 0, engine=engine, failure_timeout=0.0)
+    singles = generate_batch(4, 45, seed=72)
+    batches = [generate_batch(8, 40, seed=73 + k) for k in range(3)]
+    results = {}
+
+    def solver(k):
+        def run():
+            results[f"s{k}"] = node.peer_sudoku_solve(singles[k].tolist())
+        return run
+
+    def batcher(k):
+        def run():
+            sols, mask, _ = node.batch_sudoku_solve(batches[k].tolist())
+            assert mask.all()
+            results[f"b{k}"] = sols
+        return run
+
+    _run_threads([solver(k) for k in range(4)] + [batcher(k) for k in range(3)])
+    for k in range(4):
+        sol = results[f"s{k}"]
+        assert sol is not None and oracle_is_valid_solution(sol)
+    for k in range(3):
+        for i, sol in enumerate(results[f"b{k}"]):
+            assert oracle_is_valid_solution(sol.tolist())
+            mask = batches[k][i] > 0
+            assert (np.asarray(sol)[mask] == batches[k][i][mask]).all()
+    assert node.solved_puzzles == 4 + 3 * 8
+
+
 def test_engine_counters_consistent_under_parallel_batches(engine):
     before_v = engine.validations
     before_s = engine.solved_puzzles
