@@ -60,6 +60,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
+from .wire import valid_address
+
 
 
 class Membership:
@@ -109,8 +111,14 @@ class Membership:
             self._purge_tombstones(now)
             stale = set()
             for parent, children in received.items():
+                if not valid_address(parent) or not isinstance(
+                    children, list
+                ):
+                    continue  # hostile/corrupt flood entry (wire-fuzz)
                 live_children = []
                 for addr in children:
+                    if not valid_address(addr):
+                        continue
                     if addr in self._tombstones:
                         stale.add(addr)
                     else:

@@ -166,6 +166,13 @@ class P2PNode:
             logger.error("send to %s failed: %s", address, e)
 
     def send_to(self, peer_id: str, msg: wire.Msg) -> None:
+        # defense in depth behind the handle_message ingress validation: a
+        # malformed id that slipped into any iterated structure must cost
+        # one dropped send, never an exception that aborts a periodic
+        # pass (gossip / anti-entropy / deletion relays)
+        if not wire.valid_address(peer_id):
+            logger.warning("refusing send to invalid peer id %r", peer_id)
+            return
         self.send(wire.parse_address(peer_id), msg)
 
     def recv(self):
@@ -212,14 +219,53 @@ class P2PNode:
         # breaks when a peer binds e.g. "localhost" but datagrams arrive from
         # "127.0.0.1": the watched key would never refresh and a healthy
         # neighbor would be declared dead forever.)
+        # Ingress validation FIRST (found by tests/test_wire_fuzz.py): an
+        # address-bearing field that is not a well-formed "host:port"
+        # string must never enter ANY node state — membership sets would
+        # crash every periodic neighbor walk (gossip, anti-entropy,
+        # deletion relays) each loop iteration BEFORE reaching recv,
+        # leaving the node permanently deaf; and even _last_seen entries
+        # for garbage senders would grow without bound under a hostile
+        # flood (code-review r5). Dropped with a truncated log line; the
+        # reference crashes its handler on the same inputs.
+        if mtype in ("connect", "connected", "disconnect") and not (
+            wire.valid_address(msg.get("address"))
+        ):
+            logger.warning(
+                "dropping %s with invalid address: %.200r", mtype, msg
+            )
+            return
+        if mtype in ("solve", "solution") and not (
+            wire.valid_address(msg.get("address"))
+            and type(msg.get("row")) is int      # bools index wrong cells
+            and type(msg.get("col")) is int
+            and "sudoku" in msg
+            and (mtype != "solution" or "solution" in msg)
+        ):
+            logger.warning("dropping malformed %s: %.200r", mtype, msg)
+            return
+        if mtype == "stats" and not wire.valid_address(msg.get("origin")):
+            logger.warning("dropping stats with invalid origin: %.200r", msg)
+            return
+        if mtype == "all_peers" and not isinstance(
+            msg.get("all_peers"), dict
+        ):
+            logger.warning("dropping malformed all_peers: %.200r", msg)
+            return
+
         sender = msg.get("address") or msg.get("origin")
-        if isinstance(sender, str) and mtype != "disconnect":
+        if wire.valid_address(sender) and mtype != "disconnect":
             # (a disconnect's "address" names the DEPARTED node, not the
-            # sender — refreshing it would revive the peer being buried)
+            # sender — refreshing it would revive the peer being buried;
+            # valid_address keeps unknown-type garbage senders out of the
+            # map, and _reap_dead_neighbors GCs stale non-neighbor
+            # entries so valid-formatted flood senders can't grow it
+            # without bound either)
             self._last_seen[sender] = time.monotonic()
             # direct datagram = proof of life: clears any tombstone so a
             # false-positive death or a fast rejoin heals on first contact
             self.membership.mark_alive(sender)
+
         if mtype == "connect":
             if msg["address"] == self.id:
                 return  # never handshake with ourselves (verify r5)
@@ -598,7 +644,8 @@ class P2PNode:
             for peer in list(self._last_seen):
                 self._last_seen[peer] = now
         self._last_tick = now
-        for peer in self.membership.neighbors():
+        neighbors = set(self.membership.neighbors())
+        for peer in neighbors:
             seen = self._last_seen.setdefault(peer, now)  # grace on first sight
             if now - seen > self.failure_timeout:
                 logger.warning(
@@ -608,6 +655,16 @@ class P2PNode:
                 )
                 self._last_seen.pop(peer, None)
                 self._on_disconnect(wire.disconnect_msg(peer))
+        # GC stale non-neighbor entries: senders that never became (or no
+        # longer are) neighbors would otherwise accumulate forever under
+        # a valid-formatted hostile flood (code-review r5)
+        horizon = 10 * self.failure_timeout
+        for addr in [
+            a
+            for a, t in self._last_seen.items()
+            if a not in neighbors and now - t > horizon
+        ]:
+            del self._last_seen[addr]
 
     def shutdown(self) -> None:
         """Graceful departure (reference node.py:646-658)."""

@@ -37,6 +37,29 @@ def parse_address(address: str) -> Tuple[str, int]:
     return host, int(port)
 
 
+def valid_address(address) -> bool:
+    """True iff ``address`` is a "host:port" string that parse_address AND
+    a UDP sendto will both accept.
+
+    The ingress guard for every address-bearing field: a hostile datagram
+    whose address is a float/None/garbage string must be rejected at the
+    boundary — once such a value enters the membership sets, every
+    periodic path that walks neighbors (gossip, anti-entropy, deletion
+    relays) crashes on it each iteration BEFORE reaching recv, leaving
+    the node permanently deaf (found by tests/test_wire_fuzz.py).
+    Validation IS the parse (plus the 0-65535 sendto range): a separate
+    reimplementation accepted Unicode digits like "²" (isdigit() is True,
+    int() raises) and out-of-range ports (sendto raises OverflowError) —
+    both recreated the deafness bug past the guard (code-review r5)."""
+    if not isinstance(address, str):
+        return False
+    try:
+        host, port = parse_address(address)
+    except (ValueError, TypeError):
+        return False
+    return bool(host) and 0 <= port <= 65535
+
+
 def encode_msg(msg: Msg) -> bytes:
     return json.dumps(msg).encode()
 
