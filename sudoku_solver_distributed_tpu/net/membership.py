@@ -74,8 +74,10 @@ class Membership:
         self.all_peers: Dict[str, List[str]] = {}
         self.peers_to_reconnect: Dict[str, bool] = {}
         self._tombstones: Dict[str, float] = {}  # addr -> monotonic expiry
+        self._buried_at: Dict[str, float] = {}   # addr -> first burial time
         self._stale_seen: List[str] = []         # pushback queue (drain_stale)
         self._redial_rotation: int = 0
+        self._missing_rotation: int = 0
 
     # -- join --------------------------------------------------------------
     def on_connect(self, address: str) -> None:
@@ -83,6 +85,7 @@ class Membership:
         ground truth: it clears any tombstone for the dialer."""
         with self._lock:
             self._tombstones.pop(address, None)
+            self._buried_at.pop(address, None)  # revival resets burial age
             self.peers_out.add(address)
             self.peers_to_reconnect[address] = True
 
@@ -90,6 +93,7 @@ class Membership:
         """Inbound ``connected`` (our dial was accepted)."""
         with self._lock:
             self._tombstones.pop(address, None)
+            self._buried_at.pop(address, None)
             self.peers_in.add(address)
             self.peers_to_reconnect[address] = True
             self.all_peers[address] = [self.node_id]
@@ -99,6 +103,7 @@ class Membership:
         tombstone so a false-positive death heals on first contact."""
         with self._lock:
             self._tombstones.pop(address, None)
+            self._buried_at.pop(address, None)
 
     # -- flood merge -------------------------------------------------------
     def merge_all_peers(self, received: Dict[str, List[str]]) -> bool:
@@ -121,10 +126,12 @@ class Membership:
                         continue
                     if addr in self._tombstones:
                         stale.add(addr)
+                        self._renew_tombstone_locked(addr, now)
                     else:
                         live_children.append(addr)
                 if parent in self._tombstones:
                     stale.add(parent)
+                    self._renew_tombstone_locked(parent, now)
                     # the parent is dead but its children may be live
                     # survivors only ever advertised through it — remember
                     # them as re-dial candidates even though there is no
@@ -173,9 +180,36 @@ class Membership:
             out, self._stale_seen = self._stale_seen, []
             return out
 
+    def live_tombstones(self) -> List[str]:
+        """Currently-tombstoned addresses (for the periodic deletion
+        re-broadcast): tombstones are NODE-LOCAL state, so a node that
+        joins after a death has none and any stale view reaching it
+        resurrects the dead peer permanently (extended churn soak, seed
+        101). Re-relaying ``disconnect`` for live tombstones every
+        anti-entropy tick makes the deletion a rumor with the same
+        lifetime as the tombstone — joiners and stale holders both get
+        re-killed copies for the whole TTL."""
+        with self._lock:
+            self._purge_tombstones(time.monotonic())
+            return sorted(self._tombstones)
+
+    def _renew_tombstone_locked(self, addr: str, now: float) -> None:
+        """Seeing a tombstoned address still CIRCULATING in a flood means
+        some node holds a stale copy — extend the deletion memory so it
+        outlives the circulation (extended churn soak, seed 101: fixed
+        TTLs expired while a stale view survived, and the dead peer
+        resurrected permanently). Capped at 6x TTL from first burial so
+        a same-address rejoin is delayed at most that long at distant
+        nodes (direct contact still heals instantly via mark_alive, and
+        nodes that heard the address recently REFUSE deletion rumors —
+        node._on_disconnect)."""
+        cap = self._buried_at.get(addr, now) + 6.0 * self.tombstone_ttl_s
+        self._tombstones[addr] = min(now + self.tombstone_ttl_s, cap)
+
     def _purge_tombstones(self, now: float) -> None:
         for addr in [a for a, t in self._tombstones.items() if t < now]:
             del self._tombstones[addr]
+            self._buried_at.pop(addr, None)
 
     def second_link_target(self) -> Optional[str]:
         """If singly-connected, an address worth dialing for redundancy
@@ -221,6 +255,7 @@ class Membership:
 
             if changed:
                 self.peers_to_reconnect[address] = False
+                self._buried_at.setdefault(address, now)
                 # Tombstone only when the disconnect actually changed our
                 # view: a relayed pushback about an already-pruned address
                 # must NOT renew the tombstone, or mutually-renewing relays
@@ -269,6 +304,39 @@ class Membership:
             )
             self._redial_rotation += 1
             return known[self._redial_rotation % len(known)]
+
+    def missing_candidate(self) -> Optional[str]:
+        """A remembered, non-tombstoned address absent from the current
+        view — the partition-repair dial target. A bridge node's death
+        can split the overlay into camps that are each internally content
+        (every node keeps neighbors, so the orphan re-dial never fires)
+        yet permanently partitioned (extended churn soak, seed 101);
+        occasionally dialing a remembered absentee re-merges the camps.
+        Dead absentees cost one ignored connect datagram each."""
+        with self._lock:
+            self._purge_tombstones(time.monotonic())
+            known = self._total_peers_locked()
+            missing = [
+                a
+                for a in self.peers_to_reconnect
+                if a != self.node_id
+                and a not in known
+                and a not in self._tombstones
+            ]
+            if not missing:
+                return None
+            # flag-True (last seen alive) first: repair latency must not
+            # scale with the count of permanently-dead remembered
+            # addresses (code-review r5)
+            missing.sort(
+                key=lambda a: (not self.peers_to_reconnect.get(a, False), a)
+            )
+            self._missing_rotation += 1
+            live_count = sum(
+                1 for a in missing if self.peers_to_reconnect.get(a, False)
+            )
+            pool = missing[:live_count] if live_count else missing
+            return pool[self._missing_rotation % len(pool)]
 
     # -- views -------------------------------------------------------------
     def neighbors(self) -> List[str]:

@@ -59,7 +59,7 @@ class P2PNode:
         failure_timeout: float = FAILURE_TIMEOUT_S,
         metrics=None,
         fault_injector=None,
-        tombstone_ttl_s: float = 30.0,
+        tombstone_ttl_s: Optional[float] = None,
     ):
         self.host = host
         self.port = port
@@ -70,6 +70,17 @@ class P2PNode:
         self.engine = engine if engine is not None else SolverEngine()
         self.limiter = HandicapLimiter(base_delay=handicap)
         self._solved_count = 0
+        if tombstone_ttl_s is None:
+            # derived default: the tombstone must outlive flood convergence
+            # (seconds) but a FALSE-POSITIVE death — a live peer declared
+            # silent under load — should not exclude that peer from
+            # distant views longer than a few detection periods (extended
+            # churn soak, seed 101: a flat 30 s TTL held a live peer out
+            # for the whole convergence window). Heartbeat off (0, the
+            # reference's graceful-only model) keeps the flat default.
+            tombstone_ttl_s = (
+                max(6.0 * failure_timeout, 12.0) if failure_timeout else 30.0
+            )
         self.membership = Membership(self.id, tombstone_ttl_s=tombstone_ttl_s)
         self.stats = StatsGossip(self.id, self._own_counters)
 
@@ -329,6 +340,24 @@ class P2PNode:
 
     def _on_disconnect(self, msg: wire.Msg) -> None:
         address = msg["address"]
+        # Rumor rejection (code-review r5): a deletion relay about a peer
+        # we heard DIRECTLY within the last half failure-timeout is stale
+        # — e.g. a rejoined same-address peer being chased by another
+        # node's tombstone re-broadcast. Refusing costs nothing real: if
+        # the peer truly died an instant ago, our own heartbeat declares
+        # it within failure_timeout. Only with the heartbeat ON — with it
+        # off (reference semantics) a graceful goodbye must prune
+        # immediately, exactly as the reference does.
+        if self.failure_timeout:
+            heard = self._last_seen.get(address)
+            if (
+                heard is not None
+                and time.monotonic() - heard < self.failure_timeout / 2
+            ):
+                logger.info(
+                    "ignoring deletion rumor for recently-heard %s", address
+                )
+                return
         changed, redial = self.membership.on_disconnect(address)
         if changed:
             if self.membership.all_peers:
@@ -591,6 +620,17 @@ class P2PNode:
                     and self.membership.neighbors()
                 ):
                     self.broadcast_all_peers()
+                    # deletion anti-entropy: re-relay disconnect for every
+                    # live tombstone so nodes that joined after a death
+                    # (tombstones are local state — a joiner has none)
+                    # and stale holders both get re-killed copies; without
+                    # this, one stale view + one fresh joiner resurrects
+                    # a dead peer permanently once everyone's TTL expires
+                    # (extended churn soak, seed 101)
+                    flood_peers = self.membership.neighbors()
+                    for addr in self.membership.live_tombstones():
+                        for peer in flood_peers:
+                            self.send_to(peer, wire.disconnect_msg(addr))
                     last_anti_entropy = time.monotonic()
                 # retry the anchor until the join took (the reference blocks
                 # forever if the anchor isn't up yet, node.py:559-568); a
@@ -614,6 +654,24 @@ class P2PNode:
                             )
                             self.send_to(target, wire.connect_msg(self.id))
                             last_anchor_try = time.monotonic()
+                elif (
+                    self.membership.neighbors()
+                    and time.monotonic() - last_anchor_try > 2 * ANTI_ENTROPY_S
+                ):
+                    # partition repair: a bridge death can split the overlay
+                    # into internally-content camps (everyone keeps
+                    # neighbors, so the orphan branch never fires); dialing
+                    # a remembered address missing from the view re-merges
+                    # them (extended churn soak, seed 101). Dead absentees
+                    # cost one ignored datagram per rotation turn.
+                    target = self.membership.missing_candidate()
+                    if target is not None:
+                        logger.info(
+                            "view missing remembered peer %s — dialing",
+                            target,
+                        )
+                        self.send_to(target, wire.connect_msg(self.id))
+                    last_anchor_try = time.monotonic()
                 self._reap_dead_neighbors()
                 payload, _ = self.recv()
                 if payload is None:
@@ -638,11 +696,23 @@ class P2PNode:
         # Stall grace: if this loop itself was blocked (engine compile, a
         # long inline task, GC) past the heartbeat cadence, neighbors' gossip
         # sat unread in the socket buffer and every timestamp is stale through
-        # no fault of the peers. Give everyone a fresh window instead of
-        # mass-declaring the whole membership dead.
-        if now - self._last_tick > min(1.0, self.failure_timeout / 2):
+        # no fault of the peers. SHIFT every timestamp by the stall duration
+        # instead of resetting to now: the watcher's blind time is excused,
+        # but a genuinely dead peer keeps accumulating silence across stalls
+        # — a full reset under recurring load meant dead peers were NEVER
+        # reaped (extended churn soak, seed 101: perpetual grace on a
+        # contended core left a dead bridge in every view forever).
+        gap = now - self._last_tick
+        threshold = min(1.0, self.failure_timeout / 2)
+        if gap > threshold:
+            # excuse only the stall BEYOND the expected loop cadence: a
+            # loop that consistently ticks just over the threshold under
+            # load would otherwise excuse every gap in full and never
+            # accumulate silence for a dead peer (code-review r5); a
+            # genuinely long stall (engine compile) is still excused
+            # almost entirely
             for peer in list(self._last_seen):
-                self._last_seen[peer] = now
+                self._last_seen[peer] += gap - threshold
         self._last_tick = now
         neighbors = set(self.membership.neighbors())
         for peer in neighbors:

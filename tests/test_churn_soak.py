@@ -105,7 +105,11 @@ class Soak:
         victim.shutdown_flag = True  # SIGKILL-equivalent: no disconnect
         victim.sock.close()
 
-    def wait_converged(self, timeout=30.0):
+    def wait_converged(self, timeout=60.0):
+        # 60 s bounds the full heal pipeline on a loaded shared core:
+        # heartbeat detection (2-10 s when the loop stalls under load,
+        # shift-compensated grace), deletion flooding + tombstone
+        # anti-entropy, and the 10-s-cadence partition-repair dials
         want = {n.id for n in self.alive}
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -189,7 +193,7 @@ def test_same_address_rejoin_heals_within_ttl(engine):
                 n.shutdown()
 
 
-@pytest.mark.parametrize("seed", [11, 23, 37])
+@pytest.mark.parametrize("seed", [11, 23, 37, 101, 404])
 def test_membership_churn_soak(engine, seed):
     soak = Soak(engine, seed)
     try:
