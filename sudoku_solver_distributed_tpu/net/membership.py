@@ -209,7 +209,19 @@ class Membership:
     def _purge_tombstones(self, now: float) -> None:
         for addr in [a for a, t in self._tombstones.items() if t < now]:
             del self._tombstones[addr]
-            self._buried_at.pop(addr, None)
+        # the burial record outlives the tombstone by the full renewal cap:
+        # a re-infection (neighbor's re-broadcast right after our purge)
+        # then RESUMES the capped clock instead of restarting it — without
+        # this, holders with staggered burial windows could alternately
+        # re-infect each other and flap a live rejoined address in and out
+        # of distant views without bound (code-review r5)
+        horizon = 6.0 * self.tombstone_ttl_s
+        for addr in [
+            a
+            for a, t0 in self._buried_at.items()
+            if a not in self._tombstones and now - t0 > horizon
+        ]:
+            del self._buried_at[addr]
 
     def second_link_target(self) -> Optional[str]:
         """If singly-connected, an address worth dialing for redundancy

@@ -220,7 +220,11 @@ class P2PNode:
         return view
 
     # -- message dispatch ---------------------------------------------------
-    def handle_message(self, msg: wire.Msg) -> None:
+    def handle_message(self, msg: wire.Msg, source=None) -> None:
+        """``source`` is the datagram's UDP source (host, port) when known
+        — nodes send from their bound socket, so a graceful goodbye's
+        source equals the departing address itself, distinguishing it
+        from third-party deletion relays (rumors)."""
         mtype = msg.get("type")
         # the reference logs every datagram at INFO (node.py:194) as its
         # observability-as-oracle; DEBUG here — /metrics supersedes it
@@ -323,7 +327,7 @@ class P2PNode:
             self.stats.merge(msg)
 
         elif mtype == "disconnect":
-            self._on_disconnect(msg)
+            self._on_disconnect(msg, source=source)
 
         elif mtype == "solve":
             self._on_solve_task(msg)
@@ -338,26 +342,39 @@ class P2PNode:
         else:
             logger.warning("unknown message type: %r", mtype)
 
-    def _on_disconnect(self, msg: wire.Msg) -> None:
+    def _on_disconnect(self, msg: wire.Msg, source=None) -> None:
         address = msg["address"]
-        # Rumor rejection (code-review r5): a deletion relay about a peer
-        # we heard DIRECTLY within the last half failure-timeout is stale
-        # — e.g. a rejoined same-address peer being chased by another
-        # node's tombstone re-broadcast. Refusing costs nothing real: if
-        # the peer truly died an instant ago, our own heartbeat declares
-        # it within failure_timeout. Only with the heartbeat ON — with it
-        # off (reference semantics) a graceful goodbye must prune
-        # immediately, exactly as the reference does.
-        if self.failure_timeout:
-            heard = self._last_seen.get(address)
-            if (
-                heard is not None
-                and time.monotonic() - heard < self.failure_timeout / 2
-            ):
-                logger.info(
-                    "ignoring deletion rumor for recently-heard %s", address
-                )
-                return
+        # Rumor rejection (code-review r5): a THIRD-PARTY deletion relay
+        # about a peer we heard directly within the last half
+        # failure-timeout is stale — e.g. a rejoined same-address peer
+        # being chased by another node's tombstone re-broadcast. A
+        # graceful GOODBYE is exempt: nodes send from their bound socket,
+        # so the goodbye's UDP source equals the departing address and
+        # must prune immediately (reference semantics). Refusing a true
+        # third-party report costs nothing real: our own heartbeat
+        # re-declares the death within failure_timeout.
+        if self.failure_timeout and source is not None:
+            try:
+                # port-only match: a "localhost"-bound node's datagrams
+                # arrive from "127.0.0.1", so host comparison would
+                # mislabel its goodbye as a rumor (the same alias problem
+                # as heartbeat keying, __init__). A cross-host port
+                # collision merely HONORS the message — the pre-rejection
+                # behavior — never rejects a goodbye.
+                self_announced = source[1] == wire.parse_address(address)[1]
+            except (ValueError, TypeError, IndexError):
+                self_announced = False
+            if not self_announced:
+                heard = self._last_seen.get(address)
+                if (
+                    heard is not None
+                    and time.monotonic() - heard < self.failure_timeout / 2
+                ):
+                    logger.info(
+                        "ignoring deletion rumor for recently-heard %s",
+                        address,
+                    )
+                    return
         changed, redial = self.membership.on_disconnect(address)
         if changed:
             if self.membership.all_peers:
@@ -627,10 +644,18 @@ class P2PNode:
                     # this, one stale view + one fresh joiner resurrects
                     # a dead peer permanently once everyone's TTL expires
                     # (extended churn soak, seed 101)
-                    flood_peers = self.membership.neighbors()
-                    for addr in self.membership.live_tombstones():
-                        for peer in flood_peers:
-                            self.send_to(peer, wire.disconnect_msg(addr))
+                    # only with the heartbeat ON: in reference-semantics
+                    # mode (failure_timeout=0) rumor rejection is also
+                    # off, so re-broadcast deletions would repeatedly
+                    # prune a live same-address rejoiner at its own
+                    # neighbors (code-review r5); with graceful-only
+                    # departures every holder prunes on the goodbye and
+                    # stale views don't arise
+                    if self.failure_timeout:
+                        flood_peers = self.membership.neighbors()
+                        for addr in self.membership.live_tombstones():
+                            for peer in flood_peers:
+                                self.send_to(peer, wire.disconnect_msg(addr))
                     last_anti_entropy = time.monotonic()
                 # retry the anchor until the join took (the reference blocks
                 # forever if the anchor isn't up yet, node.py:559-568); a
@@ -645,15 +670,19 @@ class P2PNode:
                     if self.anchor_node:
                         self.connect_to_anchor_node()
                         last_anchor_try = time.monotonic()
-                    else:
-                        target = self.membership.reconnect_candidate()
-                        if target is not None:
-                            logger.info(
-                                "orphaned: re-dialing remembered peer %s",
-                                target,
-                            )
-                            self.send_to(target, wire.connect_msg(self.id))
-                            last_anchor_try = time.monotonic()
+                    # a dead (or absent) anchor must not strand us: after
+                    # each unanswered dial window, also try a remembered
+                    # peer when we know any (the joiner whose anchor died
+                    # mid-handshake — extended soak; ONE shared redial
+                    # site, code-review r5)
+                    target = self.membership.reconnect_candidate()
+                    if target is not None and target != self.anchor_node:
+                        logger.info(
+                            "no neighbors: dialing remembered peer %s",
+                            target,
+                        )
+                        self.send_to(target, wire.connect_msg(self.id))
+                        last_anchor_try = time.monotonic()
                 elif (
                     self.membership.neighbors()
                     and time.monotonic() - last_anchor_try > 2 * ANTI_ENTROPY_S
@@ -673,10 +702,10 @@ class P2PNode:
                         self.send_to(target, wire.connect_msg(self.id))
                     last_anchor_try = time.monotonic()
                 self._reap_dead_neighbors()
-                payload, _ = self.recv()
+                payload, _addr = self.recv()
                 if payload is None:
                     continue
-                self.handle_message(wire.decode_msg(payload))
+                self.handle_message(wire.decode_msg(payload), source=_addr)
             except KeyboardInterrupt:
                 self.shutdown()
             except Exception as e:  # a malformed datagram must not kill the node

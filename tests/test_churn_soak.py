@@ -92,6 +92,20 @@ class Soak:
         threading.Thread(target=node.run, daemon=True).start()
         self.nodes.append(node)
         self.alive.append(node)
+        # bootstrap discipline: if the chosen anchor dies before the
+        # handshake completes, re-point the joiner at another survivor
+        # (what an operator does when a bootstrap address is dead — a
+        # pre-handshake joiner knows no other address it could fall
+        # back to on its own)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if node.membership.neighbors():
+                break
+            others = [n for n in self.alive if n is not node]
+            if not others:
+                break  # first node: nobody to re-point to (or to wait for)
+            node.anchor_node = self.rng.choice(others).id
+            time.sleep(0.3)
         return node
 
     def graceful_leave(self):
