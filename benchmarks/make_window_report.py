@@ -84,6 +84,10 @@ def main():
     ]
     measures = [p for p in phases if p["phase"] == "measure"]
     done = [p for p in phases if p["phase"] == "done"]
+    scanner_stopped = any(
+        "scanner stopped at deadline" in ln or "session finished" in ln
+        for ln in open(LOG, errors="replace")
+    )
     notes = (
         "axon terminal services are relay-forwarded local ports (8082 "
         "claim/init, 8093 remote_compile) that open and close; the "
@@ -91,7 +95,14 @@ def main():
         f"{len(attempts)} attempt(s): {len(inits)} reached backend_up, "
         f"{len(measures)} landed measurements, {len(fails)} recorded "
         f"failure diagnostics (detail in session_events). "
-        + ("Session finished." if done else "Session/scan still running.")
+        + (
+            "Session finished."
+            if done
+            else "Scanner stopped at its deadline (claim left free for "
+            "the driver's end-of-round bench)."
+            if scanner_stopped
+            else "Session/scan still running."
+        )
     )
     report = {
         "round": ROUND,
