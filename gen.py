@@ -60,6 +60,20 @@ if __name__ == "__main__":
     seed = _opt("--seed")
     unique = "--unique" in args
 
+    # reject leftovers: a typo ("--sizes 16", "--uniq") silently yielding a
+    # default 9x9 non-unique puzzle is easy to miss in scripts, while known
+    # flags already exit with usage on error — be consistently loud
+    # (ADVICE r5 low)
+    consumed = set()
+    for flag in ("--size", "--seed"):
+        if flag in args:
+            idx = args.index(flag)
+            consumed.update((idx, idx + 1))
+    consumed.update(i for i, tok in enumerate(args) if tok == "--unique")
+    leftover = [tok for i, tok in enumerate(args) if i not in consumed]
+    if leftover:
+        _usage(f"unknown argument(s): {' '.join(leftover)}")
+
     # early size validation (perfect square) — the generator's diagonal
     # fill would otherwise die with an opaque IndexError
     from sudoku_solver_distributed_tpu.ops import spec_for_size

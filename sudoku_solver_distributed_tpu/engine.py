@@ -73,6 +73,30 @@ class SolverEngine:
         ops.SERVING_CONFIG; see ops/config.py for the measured rationale).
       max_iters: lockstep iteration budget per device call (None →
         ops.SERVING_CONFIG).
+      coalesce: route bucket-path ``solve_one``/``solve_one_async`` calls
+        through the request-coalescing micro-batch scheduler
+        (parallel/coalescer.py) so concurrent requests share one device
+        call. Default on — this is the serving path; False restores the
+        seed's one-device-call-per-request behavior.
+      coalesce_max_wait_s: longest a lone request waits for co-riders
+        before its batch dispatches anyway (default 2 ms — the <5 ms p50
+        contract minus headroom).
+      coalesce_quiescence_s / coalesce_burst_wait_s: burst-absorption
+        tuning (parallel/coalescer.py): at the max-wait deadline the
+        dispatcher keeps absorbing while requests arrived within the
+        last quiescence_s (default 1 ms), bounded by burst_wait_s past
+        the oldest arrival (default 10× max-wait). A lone request is
+        never delayed by either.
+      coalesce_inflight_depth: dispatched-but-unfetched batches the
+        coalescer pipelines (2 = host/device double buffering).
+      coalesce_max_batch: cap on boards per coalesced device call (None →
+        the largest bucket). On TPU the widest bucket is the whole point;
+        on the CPU fallback a wide batch of MIXED boards pays the
+        worst board's iteration count across the full width (lockstep
+        batch semantics) and per-board throughput collapses past the
+        SIMD sweet spot — measured hard-corpus boards/s on 2 cores:
+        batch-1 552, batch-8 2758, batch-64 854. Serving benches cap at
+        8 on CPU (bench.py --mode concurrent).
 
     All unspecified solver knobs resolve from ops.SERVING_CONFIG, the single
     definition site shared with bench.py and __graft_entry__ — the benched
@@ -97,6 +121,12 @@ class SolverEngine:
         naked_pairs: Optional[bool] = None,
         max_iters: Optional[int] = None,
         deep_retry_factor: int = 16,
+        coalesce: bool = True,
+        coalesce_max_wait_s: float = 0.002,
+        coalesce_quiescence_s: float = 0.001,
+        coalesce_burst_wait_s: Optional[float] = None,
+        coalesce_inflight_depth: int = 2,
+        coalesce_max_batch: Optional[int] = None,
     ):
         if backend not in ("xla", "pallas"):
             raise ValueError(f"unknown engine backend {backend!r}")
@@ -256,6 +286,24 @@ class SolverEngine:
         # auto-routed requests whose quick probe hit the escalation budget
         # and went to the race (frontier_route="auto")
         self.frontier_escalations = 0
+        # Request coalescing (parallel/coalescer.py): single-board solves on
+        # the bucket path ride a shared micro-batch scheduler so concurrent
+        # clients fill the pre-compiled buckets instead of each paying a
+        # batch-1 device call. Lazily constructed (threads only exist once
+        # the serving path is actually exercised); frontier-routed requests
+        # bypass it (solve_one).
+        self.coalesce = coalesce
+        self.coalesce_max_wait_s = coalesce_max_wait_s
+        self.coalesce_quiescence_s = coalesce_quiescence_s
+        self.coalesce_burst_wait_s = coalesce_burst_wait_s
+        self.coalesce_inflight_depth = coalesce_inflight_depth
+        self.coalesce_max_batch = coalesce_max_batch
+        self._coalescer = None
+        self._coalescer_init_lock = threading.Lock()
+        # flips once warmup() has compiled every bucket — observable at
+        # /metrics (health) so operators/benchmarks can tell a warm node
+        # from one still background-compiling its ladder
+        self.warmed = False
 
         def _run(grid, mi=max_iters):
             B = grid.shape[0]
@@ -374,6 +422,32 @@ class SolverEngine:
         (local mesh or multi-host serving loop)."""
         return self.frontier_mesh is not None or self.frontier_runner is not None
 
+    @property
+    def coalescer(self):
+        """The engine's request coalescer, created (threads started) on
+        first use so engines that never serve single-board traffic pay
+        nothing. One per engine: the shared queue IS the batching."""
+        if self._coalescer is None:
+            with self._coalescer_init_lock:
+                if self._coalescer is None:
+                    from .parallel.coalescer import BatchCoalescer
+
+                    self._coalescer = BatchCoalescer(
+                        self,
+                        max_wait_s=self.coalesce_max_wait_s,
+                        quiescence_s=self.coalesce_quiescence_s,
+                        burst_wait_s=self.coalesce_burst_wait_s,
+                        inflight_depth=self.coalesce_inflight_depth,
+                        max_batch=self.coalesce_max_batch,
+                    )
+        return self._coalescer
+
+    def close(self) -> None:
+        """Drain and stop the coalescer (futures resolve before return).
+        Safe to call on an engine that never coalesced; idempotent."""
+        if self._coalescer is not None:
+            self._coalescer.close()
+
     def health(self) -> dict:
         """Operator-facing engine health, served under /metrics "engine".
 
@@ -389,7 +463,11 @@ class SolverEngine:
             "frontier_handoff": self.frontier_handoff,
             "frontier_fallbacks": self.frontier_fallbacks,
             "frontier_escalations": self.frontier_escalations,
+            "coalesce": self.coalesce,
+            "warmed": self.warmed,
         }
+        if self._coalescer is not None:
+            out["coalescer"] = self._coalescer.stats()
         loop = self.frontier_loop
         if loop is None:
             # fallback: a bare bound FrontierServingLoop.solve as the runner
@@ -412,16 +490,27 @@ class SolverEngine:
             arr = jax.device_put(arr, self.sharding)
         return arr
 
-    def _solve_padded(self, boards: np.ndarray) -> np.ndarray:
-        """Solve ≤bucket boards, padding with empty boards (always solvable).
+    def _dispatch_padded(self, boards: np.ndarray):
+        """Pad ≤bucket boards into their bucket and launch ONE device call.
 
-        Returns the packed (n, C+4) host array: [grid | solved | status |
-        guesses | validations] per row.
+        Returns an opaque in-flight handle for ``_finalize_padded``. The
+        device call is async-dispatched: this returns as soon as the program
+        is enqueued, so a caller (the coalescer's dispatcher thread) can
+        encode/pad batch N+1 on the host while batch N runs on device.
         """
         n = boards.shape[0]
         bucket = self._bucket_for(n)
         if n < bucket:
-            pad = np.zeros((bucket - n, *boards.shape[1:]), boards.dtype)
+            # Pad with a COPY of a real row, not empty boards: the lockstep
+            # kernel runs until the slowest board in the bucket finishes,
+            # and an empty board's full-blown DFS costs ~10× a typical
+            # request (measured on CPU: 34 boards + 30 empty pads 150 ms vs
+            # 64 real boards 13 ms in the same bucket-64 program) — pad
+            # rows must never dominate the batch they ride in. A duplicate
+            # of boards[0] adds zero extra iterations by construction.
+            pad = np.broadcast_to(
+                boards[0], (bucket - n, *boards.shape[1:])
+            )
             boards = np.concatenate([boards, pad], axis=0)
         if self.profile_dir is not None and self._profile_mutex.acquire(
             blocking=False
@@ -435,11 +524,19 @@ class SolverEngine:
                 self._profile_mutex.release()
         else:
             packed = self._solve(self._device_batch(boards))
+        return packed, boards, n
+
+    def _finalize_padded(self, packed, boards: np.ndarray, n: int) -> np.ndarray:
+        """Fetch an in-flight ``_dispatch_padded`` call (blocks on the
+        device) and run the deep-retry safety net on any capped rows.
+
+        Returns the packed (n, C+4) host array: [grid | solved | status |
+        guesses | validations] per row.
+        """
         packed = np.array(packed)
         C = self.spec.cells
         running = packed[:, C + 1] == RUNNING
-        # trigger on REAL rows only: under a tiny cap the empty pad boards
-        # can themselves hit it, and a deep pass for discarded lanes is
+        # trigger on REAL rows only: a deep pass for discarded pad lanes is
         # pure waste (the merge below may still overwrite pad rows — they
         # are sliced off either way)
         if running[:n].any():
@@ -455,12 +552,15 @@ class SolverEngine:
             sub = boards[capped]
             bucket2 = self._bucket_for(len(capped))
             if len(capped) < bucket2:
+                # same real-row padding rationale as _dispatch_padded —
+                # here doubly so: the deep retry runs at deep_retry_factor×
+                # the budget, and an empty-board pad could spin that whole
+                # budget while every real lane sits finished
                 sub = np.concatenate(
                     [
                         sub,
-                        np.zeros(
-                            (bucket2 - len(capped), *boards.shape[1:]),
-                            boards.dtype,
+                        np.broadcast_to(
+                            sub[0], (bucket2 - len(capped), *boards.shape[1:])
                         ),
                     ],
                     axis=0,
@@ -471,6 +571,38 @@ class SolverEngine:
             packed[capped, C + 2] += first[:, C + 2]
             packed[capped, C + 3] += first[:, C + 3]
         return packed[:n]
+
+    def _solve_padded(self, boards: np.ndarray) -> np.ndarray:
+        """Solve ≤bucket boards, padding with duplicates of the first row.
+
+        Synchronous composition of ``_dispatch_padded`` + ``_finalize_padded``
+        (the coalescer runs the two phases on separate threads instead).
+        """
+        return self._finalize_padded(*self._dispatch_padded(boards))
+
+    def _account_coalesced(self, rows: np.ndarray) -> None:
+        """Fold one coalesced batch's work into the engine counters — the
+        same accounting ``solve_batch_np`` does for its callers."""
+        C = self.spec.cells
+        with self._lock:
+            self.validations += int(rows[:, C + 3].sum())
+            self.solved_puzzles += int(rows[:, C].sum())
+
+    def _row_result(self, row: np.ndarray):
+        """One packed host row → the (solution | None, info) contract of
+        ``solve_one``. ``capped`` keeps the not-finished ≠ proven-UNSAT
+        distinction (the deep retry already ran in _finalize_padded)."""
+        C = self.spec.cells
+        N = self.spec.size
+        solved = bool(row[C])
+        info = {
+            "validations": int(row[C + 3]),
+            "guesses": int(row[C + 2]),
+            "capped": int(row[C + 1] == RUNNING),
+            "routed": "coalesced",
+        }
+        solution = row[:C].reshape(N, N).tolist() if solved else None
+        return solution, info
 
     # -- public API --------------------------------------------------------
     def warmup(self) -> None:
@@ -529,6 +661,7 @@ class SolverEngine:
                     frontier._unsat_pad(self.spec), (target * mult, N, N)
                 )
                 np.asarray(racer(jnp.asarray(pad)))
+        self.warmed = True
 
     def solve_batch_np(self, boards: np.ndarray) -> Tuple[np.ndarray, np.ndarray, dict]:
         """Solve (B, N, N) boards.
@@ -799,15 +932,55 @@ class SolverEngine:
                 )
                 with self._lock:
                     self.frontier_fallbacks += 1
-        solutions, solved_mask, info = self.solve_batch_np(arr[None])
-        if not solved_mask[0]:
-            if info.get("capped"):
-                # the HTTP surface must answer the reference's exact
-                # "No solution found" body either way (http_api.py), so
-                # the not-finished-vs-proven-UNSAT distinction lives here
-                logger.warning(
-                    "solve_one: iteration budget exhausted (deep retry "
-                    "included) — board not finished, NOT proven unsolvable"
-                )
-            return None, info
-        return solutions[0].tolist(), info
+        return self._solve_one_bucket(arr)
+
+    def _solve_one_bucket(self, arr: np.ndarray):
+        """Single-board bucket path: coalesced with concurrent requests
+        when enabled (parallel/coalescer.py), else a direct batch-1 call."""
+        if self.coalesce:
+            solution, info = self.coalescer.solve(arr)
+        else:
+            solutions, solved_mask, info = self.solve_batch_np(arr[None])
+            solution = solutions[0].tolist() if solved_mask[0] else None
+        if solution is None and info.get("capped"):
+            # the HTTP surface must answer the reference's exact
+            # "No solution found" body either way (http_api.py), so
+            # the not-finished-vs-proven-UNSAT distinction lives here
+            logger.warning(
+                "solve_one: iteration budget exhausted (deep retry "
+                "included) — board not finished, NOT proven unsolvable"
+            )
+        return solution, info
+
+    def solve_one_async(
+        self,
+        board: Sequence[Sequence[int]],
+        *,
+        frontier: Optional[bool] = None,
+    ):
+        """``solve_one`` returning a ``concurrent.futures.Future``.
+
+        Bucket-path requests enqueue on the coalescer and return
+        immediately — handler threads await the future instead of
+        contending on a lock, and concurrent requests share one device
+        call. Frontier-routed requests (and engines with ``coalesce=False``)
+        bypass the coalescer and run inline in the calling thread: the race
+        occupies the whole mesh by design and must not stall the bucket
+        pipeline behind it.
+        """
+        from concurrent.futures import Future
+
+        arr = np.asarray(board, np.int32)
+        use_frontier = (
+            self.frontier_enabled
+            if frontier is None
+            else (frontier and self.frontier_enabled)
+        )
+        if self.coalesce and not use_frontier:
+            return self.coalescer.submit(arr)
+        fut: Future = Future()
+        try:
+            fut.set_result(self.solve_one(board, frontier=frontier))
+        except BaseException as e:  # noqa: BLE001 — deliver through the future
+            fut.set_exception(e)
+        return fut

@@ -22,6 +22,16 @@ Extensions (defaults preserve reference behavior):
                 the engine's bucketed batch path (the bench.py throughput
                 strength on the serving surface); off by default, same
                 404-parity reason
+  --serving-stats
+                add a "serving" block (request-coalescer batch-fill, queue
+                depth, wait times) to GET /stats; off by default so the
+                reference's {"all","nodes"} body stays byte-identical
+  --no-coalesce / --coalesce-max-wait-ms / --coalesce-max-batch
+                disable or tune the request-coalescing micro-batch
+                scheduler (parallel/coalescer.py) that merges concurrent
+                /solve requests into one bucketed device call; max-batch
+                caps boards per call at the backend's efficient width
+                (8 on the CPU fallback — engine.py rationale)
   --profile-dir write a jax.profiler device trace of each /solve to this dir
   --failure-timeout
                 seconds of neighbor silence before a crash is declared (the
@@ -79,6 +89,44 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="expose POST /solve_batch (the engine's bucketed batch path "
         "over HTTP; opt-in — off keeps the reference 404 surface)",
+    )
+    parser.add_argument(
+        "--serving-stats",
+        action="store_true",
+        help="add a 'serving' block (coalescer batch-fill / queue-depth / "
+        "wait-time) to GET /stats; opt-in — off keeps the reference "
+        "stats body byte-identical",
+    )
+    parser.add_argument(
+        "--no-coalesce",
+        action="store_true",
+        help="disable the request-coalescing micro-batch scheduler: every "
+        "/solve pays its own batch-1 device call (the pre-coalescer "
+        "serving path; for A/B measurement)",
+    )
+    parser.add_argument(
+        "--seed-serving",
+        action="store_true",
+        help="serve exactly like the seed for A/B measurement: requests "
+        "serialized behind one lock, no coalescer, HTTP/1.0 transport on "
+        "the stock 5-deep accept queue (bench.py --mode concurrent's "
+        "baseline phase)",
+    )
+    parser.add_argument(
+        "--coalesce-max-wait-ms",
+        type=float,
+        default=2.0,
+        help="longest a lone request waits for batch co-riders before its "
+        "bucket dispatches anyway (default 2 ms)",
+    )
+    parser.add_argument(
+        "--coalesce-max-batch",
+        type=int,
+        default=None,
+        help="cap boards per coalesced device call (default: the largest "
+        "bucket). Set to the backend's efficient width — e.g. 8 on the "
+        "CPU fallback, where a wide batch of mixed boards pays the worst "
+        "board's iterations across the full width (engine.py rationale)",
     )
     parser.add_argument(
         "--profile-dir", default=None, help="jax.profiler trace output dir"
@@ -185,7 +233,13 @@ def main(argv=None) -> None:
     from ..engine import SolverEngine
     from ..ops import spec_for_size
 
-    kwargs = {"spec": spec_for_size(args.board_size), "backend": args.backend}
+    kwargs = {
+        "spec": spec_for_size(args.board_size),
+        "backend": args.backend,
+        "coalesce": not (args.no_coalesce or args.seed_serving),
+        "coalesce_max_wait_s": args.coalesce_max_wait_ms / 1e3,
+        "coalesce_max_batch": args.coalesce_max_batch,
+    }
     if args.buckets:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
     multi_host = bool(args.coordinator) and args.num_hosts > 1
@@ -233,6 +287,7 @@ def main(argv=None) -> None:
         mesh_peer_count=args.mesh_peers,
         failure_timeout=args.failure_timeout,
         metrics=RequestMetrics(),
+        serialize_solves=args.seed_serving,
     )
     if args.profile_dir:
         node.engine.profile_dir = args.profile_dir
@@ -245,6 +300,8 @@ def main(argv=None) -> None:
         node, args.host, args.p,
         expose_metrics=args.metrics,
         expose_batch=args.batch_api,
+        expose_serving=args.serving_stats,
+        legacy_transport=args.seed_serving,
     )
     http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     http_thread.start()
@@ -252,5 +309,6 @@ def main(argv=None) -> None:
         node.run()
     finally:
         httpd.shutdown()
+        engine.close()  # drain the coalescer (in-flight futures resolve)
         if serving_loop is not None and serving_loop.is_leader:
             serving_loop.stop()

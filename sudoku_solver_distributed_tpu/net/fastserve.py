@@ -1,0 +1,238 @@
+"""Lean threaded HTTP/1.1 transport for the serving hot path.
+
+`http.server`'s BaseHTTPRequestHandler costs ~1-2 ms of pure-Python (and
+GIL-held) time per request — request-line regex, an email.parser pass over
+the headers, date formatting for every response. On a CPU-fallback host
+that overhead, not the device, is the serving ceiling: the coalescer
+merges device work so well (parallel/coalescer.py) that the transport
+becomes the bottleneck (measured ~330 puzzles/s flat with http.server vs
+~2700 boards/s of warm bucket-8 device capacity on 2 cores).
+
+This module is the matching inference-stack transport: a thread per
+connection reading keep-alive requests off one buffered socket file,
+parsing just the request line + the three headers that matter
+(Content-Length / Transfer-Encoding / Connection), and answering from a
+pre-baked header template. Route handling and response BODIES are the
+exact shared cores in http_api.py (`solve_route`, `solve_batch_route`,
+`stats_payload`, `metrics_payload`), so the serving surface stays
+byte-identical to the reference no matter which transport carried it —
+the A/B in `bench.py --mode concurrent` measures this stack against the
+seed's (`--seed-serving` keeps the stock http.server + HTTP/1.0 path).
+
+Framing rules match the stock handler's `_read_body`: a request whose
+body cannot be consumed (chunked transfer, malformed/negative
+Content-Length, over the size cap) answers 400 and closes — leftover
+body bytes on a persistent connection would be parsed as the next
+request's start line. Unknown POST paths also close, keeping the stock
+handler's contract (tests/test_net_node.py keep-alive suite runs against
+whichever transport `make_http_server` returns).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+
+from . import http_api
+
+logger = logging.getLogger(__name__)
+
+_REASONS = {200: b"OK", 400: b"Bad Request", 404: b"Not Found"}
+# generous cap for any route; /solve_batch's documented bound (http_api)
+_MAX_BODY = http_api.MAX_BATCH_BYTES
+_MAX_LINE = 65536
+_MAX_HEADERS = 100
+
+
+class FastHTTPServer:
+    """Drop-in for ThreadingHTTPServer's lifecycle surface:
+    ``serve_forever()`` blocks (run it in a thread), ``shutdown()`` stops
+    the accept loop, ``server_address`` carries the bound (host, port).
+    In-flight connections are daemon threads; ``shutdown`` stops new
+    accepts and lets live requests finish."""
+
+    def __init__(
+        self,
+        p2p_node,
+        host: str,
+        port: int,
+        *,
+        expose_metrics: bool = False,
+        expose_batch: bool = False,
+        expose_serving: bool = False,
+    ):
+        self.p2p_node = p2p_node
+        self.expose_metrics = expose_metrics
+        self.expose_batch = expose_batch
+        self.expose_serving = expose_serving
+        # deep accept queue, same rationale as the old _ThreadingHTTPServer:
+        # the stock 5-deep backlog drops SYNs under a 64-client burst and
+        # the overflow crawls through 1/3/7 s retransmit backoff
+        self._sock = socket.create_server(
+            (host, port), backlog=1024, reuse_port=False
+        )
+        self.server_address = self._sock.getsockname()
+        self._shutdown = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self) -> None:
+        while not self._shutdown:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                break  # listener closed by shutdown()
+            threading.Thread(
+                target=self._serve_connection,
+                args=(conn,),
+                daemon=True,
+            ).start()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    server_close = shutdown  # stock servers expose both
+
+    # -- connection loop ---------------------------------------------------
+    def _serve_connection(self, conn: socket.socket) -> None:
+        conn.settimeout(300.0)  # reap half-dead keep-alive clients
+        rfile = conn.makefile("rb", -1)
+        try:
+            while not self._shutdown:
+                if not self._handle_one(conn, rfile):
+                    break
+        except (OSError, ValueError):
+            pass  # client went away mid-request; nothing to answer
+        finally:
+            try:
+                rfile.close()
+            finally:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                conn.close()
+
+    def _handle_one(self, conn, rfile) -> bool:
+        """Serve one request; returns False when the connection is done."""
+        line = rfile.readline(_MAX_LINE + 1)
+        if not line:
+            return False  # client closed cleanly between requests
+        if line in (b"\r\n", b"\n"):
+            return True  # tolerate a stray blank line (RFC 9112 §2.2)
+        t0 = time.perf_counter()
+        parts = line.split()
+        if len(parts) != 3 or len(line) > _MAX_LINE:
+            return False  # not HTTP; drop the connection
+        method, path, version = parts
+        headers = {}
+        for _ in range(_MAX_HEADERS):
+            h = rfile.readline(_MAX_LINE + 1)
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if len(h) > _MAX_LINE or not h.endswith(b"\n"):
+                # oversize or truncated header line: readline returned a
+                # fragment, and the NEXT readline would re-parse its tail
+                # as a forged header (e.g. a smuggled content-length that
+                # desyncs keep-alive framing) — drop the connection
+                return False
+            key, sep, value = h.partition(b":")
+            if sep:
+                headers[key.strip().lower()] = value.strip()
+        else:
+            return False  # header flood; drop
+
+        close = version == b"HTTP/1.0" or (
+            headers.get(b"connection", b"").lower() == b"close"
+        )
+
+        # body framing (mirrors the stock handler's _read_body contract)
+        te = headers.get(b"transfer-encoding", b"").lower()
+        try:
+            content_length = int(headers.get(b"content-length", 0))
+        except ValueError:
+            content_length = -1
+        body = b""
+        bad_frame = (
+            content_length < 0
+            or b"chunked" in te
+            or content_length > _MAX_BODY
+        )
+        if not bad_frame and content_length:
+            body = rfile.read(content_length)
+            if len(body) < content_length:
+                return False  # client died mid-body
+        if bad_frame:
+            path_s = path.decode("latin-1")
+            if path_s in ("/solve", "/solve_batch"):
+                self._record(path_s, t0, error=True)
+            self._reply(conn, 400, {"error": "Invalid request"}, close=True)
+            return False
+
+        status, payload, close_after = self._route(
+            method, path.decode("latin-1"), body, t0
+        )
+        self._reply(conn, status, payload, close=close or close_after)
+        return not (close or close_after)
+
+    # -- routing -----------------------------------------------------------
+    def _route(self, method: bytes, path: str, body: bytes, t0: float):
+        """Returns (status, payload, close_after). Bodies come from the
+        shared route cores — byte-identical to the stock transport."""
+        node = self.p2p_node
+        if method == b"POST":
+            if path == "/solve":
+                status, payload, error = http_api.solve_route(node, body)
+                self._record("/solve", t0, error=error)
+                return status, payload, False
+            if path == "/solve_batch" and self.expose_batch:
+                status, payload, error = http_api.solve_batch_route(
+                    node, body
+                )
+                self._record("/solve_batch", t0, error=error)
+                return status, payload, False
+            # unknown POST path: the stock handler never reads these
+            # bodies and must close; this transport already consumed the
+            # body, but it keeps the same observable contract
+            return 404, {"error": "Invalid endpoint"}, True
+        if method == b"GET":
+            if path == "/stats":
+                return (
+                    200,
+                    http_api.stats_payload(node, self.expose_serving),
+                    False,
+                )
+            if path == "/network":
+                return 200, node.network_view(), False
+            if path == "/metrics" and self.expose_metrics:
+                return 200, http_api.metrics_payload(node), False
+        return 404, {"error": "Invalid endpoint"}, False
+
+    def _record(self, route: str, t0: float, error: bool = False) -> None:
+        m = getattr(self.p2p_node, "metrics", None)
+        if m is not None:
+            m.record(route, time.perf_counter() - t0, error=error)
+
+    # -- response ----------------------------------------------------------
+    @staticmethod
+    def _reply(conn, status: int, payload, *, close: bool) -> None:
+        body = json.dumps(payload).encode()
+        head = (
+            b"HTTP/1.1 %d %s\r\n"
+            b"Content-type: application/json\r\n"
+            b"Content-Length: %d\r\n"
+            b"%s\r\n"
+            % (
+                status,
+                _REASONS.get(status, b"Unknown"),
+                len(body),
+                b"Connection: close\r\n" if close else b"",
+            )
+        )
+        conn.sendall(head + body)

@@ -24,6 +24,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 logger = logging.getLogger(__name__)
 
 
+MAX_BATCH = 4096        # board-count guard for /solve_batch
+MAX_BATCH_BYTES = 32 << 20  # body-size guard, checked before buffering
+
+
 def _board_error(sudoku, size: int) -> str | None:
     """Semantic body validation: reject JSON-valid-but-malformed boards
     before they reach the engine (VERDICT r4 task 2). The reference crashes
@@ -43,19 +47,143 @@ def _board_error(sudoku, size: int) -> str | None:
     return None
 
 
+# -- route cores -------------------------------------------------------------
+# Shared by the stock handler below (the seed's transport, kept for
+# --seed-serving A/B runs) and the lean serving transport (fastserve.py):
+# each takes the already-framed request body and returns
+# (status, payload, error_flag) so response bodies stay byte-identical no
+# matter which transport carried the request.
+
+
+def solve_route(p2p_node, body: bytes):
+    """POST /solve: the reference's solve surface (node.py:661-690)."""
+    # debug, not info: two formatted log records per request is measurable
+    # GIL time under a 64-client closed loop (the reference logs every
+    # request at INFO, but its serving path was never multi-tenant);
+    # error paths still log at info
+    t_in = time.time()
+    logger.debug("received /solve POST request")
+    try:
+        sudoku = json.loads(body.decode("utf-8"))["sudoku"]
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+        # TypeError: a JSON-valid non-object body ([1,2,3], "foo") makes
+        # body["sudoku"] a non-subscript access — same 400, never a dead
+        # handler thread (code-review r5)
+        return 400, {"error": "Invalid request"}, True
+    reason = _board_error(sudoku, p2p_node.engine.spec.size)
+    if reason is not None:
+        logger.info("rejected /solve body: %s", reason)
+        return 400, {"error": "Invalid request"}, True
+    solution = p2p_node.peer_sudoku_solve(sudoku)
+    logger.debug("execution time: %s", time.time() - t_in)
+    if solution:
+        return 200, solution, False
+    return 400, {"error": "No solution found", "solution": solution}, True
+
+
+def solve_batch_route(p2p_node, body: bytes):
+    """POST /solve_batch (opt-in extension, not a reference surface): the
+    engine's bucketed batch path over HTTP — the framework's headline
+    strength (bench.py throughput) reachable by a serving client, instead
+    of one board per request. Body: {"sudokus": [grid, ...]} →
+    {"solutions": [grid|null, ...], "solved": n, "capped": n}. null rows
+    mean not solved; capped counts rows whose search exhausted the
+    iteration budget (not finished ≠ proven unsatisfiable, engine.py)."""
+    try:
+        sudokus = json.loads(body.decode())["sudokus"]
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+        return 400, {"error": "Invalid request"}, True
+    size = p2p_node.engine.spec.size
+    if not isinstance(sudokus, list) or not 1 <= len(sudokus) <= MAX_BATCH:
+        reason = f"need 1..{MAX_BATCH} boards"
+    else:
+        reason = next(
+            filter(None, (_board_error(s, size) for s in sudokus)), None
+        )
+    if reason is not None:
+        logger.info("rejected /solve_batch body: %s", reason)
+        return 400, {"error": "Invalid request"}, True
+    solutions, mask, info = p2p_node.batch_sudoku_solve(sudokus)
+    return (
+        200,
+        {
+            "solutions": [
+                sol.tolist() if ok else None
+                for sol, ok in zip(solutions, mask)
+            ],
+            "solved": int(mask.sum()),
+            "capped": info["capped"],
+        },
+        False,
+    )
+
+
+def stats_payload(p2p_node, expose_serving: bool):
+    """GET /stats: the merged all_stats shape; the serving block
+    (coalescer counters, net/stats.serving_snapshot) is an extension key
+    next to the reference's "all"/"nodes", only when the operator asked
+    for it."""
+    body = p2p_node.get_stats()
+    if expose_serving:
+        from .stats import serving_snapshot
+
+        eng = getattr(p2p_node, "engine", None)
+        if eng is not None:
+            body["serving"] = serving_snapshot(eng)
+    return body
+
+
+def metrics_payload(p2p_node):
+    """GET /metrics (opt-in): per-route percentiles plus engine health
+    (frontier fallbacks / serving-loop liveness) and membership churn
+    machinery — route keys all start with "/", so the extra keys can't
+    collide."""
+    m = getattr(p2p_node, "metrics", None)
+    body = m.summary() if m is not None else {}
+    eng = getattr(p2p_node, "engine", None)
+    if eng is not None and hasattr(eng, "health"):
+        body["engine"] = eng.health()
+    m_health = getattr(
+        getattr(p2p_node, "membership", None), "health", None
+    )
+    if m_health is not None:
+        body["membership"] = m_health()
+    return body
+
+
 class SudokuHTTPHandler(BaseHTTPRequestHandler):
+    # The stock http.server handler. The default serving transport is now
+    # net/fastserve.py (same route cores, ~an order of magnitude less
+    # pure-Python per request); this class carries the seed's transport
+    # for --seed-serving A/B runs (make_http_server pins it to HTTP/1.0
+    # there: a connection per request, exactly the seed's per-request
+    # cost). Kept HTTP/1.1-capable — keep-alive needs the Content-Length
+    # header _send_response sets; response bodies are byte-identical to
+    # the reference either way.
+    protocol_version = "HTTP/1.1"
     p2p_node = None       # set by make_http_server
     expose_metrics = False  # opt-in /metrics route (CLI --metrics); default
     #                         off keeps the 404 surface byte-identical
     expose_batch = False    # opt-in POST /solve_batch (CLI --batch-api):
     #                         the engine's bucketed batch path through HTTP
-    MAX_BATCH = 4096        # board-count guard for /solve_batch
-    MAX_BATCH_BYTES = 32 << 20  # body-size guard, checked before buffering
+    expose_serving = False  # opt-in "serving" block on GET /stats (CLI
+    #                         --serving-stats): coalescer batch-fill /
+    #                         queue-depth / wait-time counters; off keeps
+    #                         the reference {"all","nodes"} body exact
+    MAX_BATCH = MAX_BATCH
+    MAX_BATCH_BYTES = MAX_BATCH_BYTES
 
     def _send_response(self, content, status: int = 200) -> None:
         body = json.dumps(content).encode()
         self.send_response(status)
         self.send_header("Content-type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # a handler that bailed without consuming the request body sets
+            # close_connection (leftover bytes would desync keep-alive
+            # framing); tell the client so it reconnects instead of
+            # reusing a connection the server is about to drop
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
@@ -64,121 +192,68 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
         if m is not None:
             m.record(route, time.perf_counter() - t0, error=error)
 
+    def _read_body(self, route: str, t0: float, max_bytes=None):
+        """Read the request body with keep-alive-safe framing. Returns the
+        bytes, or None after answering 400 — closing the connection when
+        the body could NOT be consumed (chunked transfer, malformed or
+        negative Content-Length, over ``max_bytes``): leftover body bytes
+        on a persistent connection would be parsed as the next request's
+        start line. Harmless on HTTP/1.0 (every reply closes), load-bearing
+        since the switch to HTTP/1.1."""
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        try:
+            content_length = int(self.headers.get("Content-Length", 0))
+        except (ValueError, TypeError):
+            content_length = -1
+        if (
+            content_length < 0
+            or "chunked" in te
+            or (max_bytes is not None and content_length > max_bytes)
+        ):
+            self.close_connection = True
+            self._record(route, t0, error=True)
+            self._send_response({"error": "Invalid request"}, 400)
+            return None
+        return self.rfile.read(content_length)
+
     def do_POST(self):
         t0 = time.perf_counter()
         if self.path == "/solve":
-            initial_time = time.time()
-            logger.info("received /solve POST request")
-            try:
-                content_length = int(self.headers.get("Content-Length", 0))
-                post_data = self.rfile.read(content_length)
-                sudoku = json.loads(post_data.decode("utf-8"))["sudoku"]
-            except (ValueError, KeyError, TypeError, UnicodeDecodeError):
-                # TypeError: a JSON-valid non-object body ([1,2,3], "foo")
-                # makes body["sudoku"] a non-subscript access — same 400,
-                # never a dead handler thread (code-review r5).
-                # record before replying: a client may poll /metrics the
-                # instant its response arrives
-                self._record("/solve", t0, error=True)
-                self._send_response({"error": "Invalid request"}, 400)
+            post_data = self._read_body("/solve", t0)
+            if post_data is None:
                 return
-            size = self.p2p_node.engine.spec.size
-            reason = _board_error(sudoku, size)
-            if reason is not None:
-                logger.info("rejected /solve body: %s", reason)
-                self._record("/solve", t0, error=True)
-                self._send_response({"error": "Invalid request"}, 400)
-                return
-            solution = self.p2p_node.peer_sudoku_solve(sudoku)
-            logger.info("execution time: %s", time.time() - initial_time)
-            if solution:
-                self._record("/solve", t0)
-                self._send_response(solution)
-            else:
-                self._record("/solve", t0, error=True)
-                self._send_response(
-                    {"error": "No solution found", "solution": solution}, 400
-                )
+            status, payload, error = solve_route(self.p2p_node, post_data)
+            # record before replying: a client may poll /metrics the
+            # instant its response arrives
+            self._record("/solve", t0, error=error)
+            self._send_response(payload, status)
         elif self.path == "/solve_batch" and self.expose_batch:
-            # Opt-in extension (not a reference surface): the engine's
-            # bucketed batch path over HTTP — the framework's headline
-            # strength (bench.py throughput) reachable by a serving
-            # client, instead of one board per request. Body:
-            # {"sudokus": [grid, ...]} → {"solutions": [grid|null, ...],
-            # "solved": n, "capped": n}. null rows mean not solved;
-            # capped counts rows whose search exhausted the iteration
-            # budget (not finished ≠ proven unsatisfiable, engine.py).
-            try:
-                content_length = int(self.headers.get("Content-Length", 0))
-                if content_length > self.MAX_BATCH_BYTES:
-                    # bound memory BEFORE buffering the body: a batch
-                    # endpoint invites large payloads (code-review r5);
-                    # 4096 25x25 boards serialize to ~8 MB, so the cap
-                    # is generous for every legitimate request
-                    self._record("/solve_batch", t0, error=True)
-                    self._send_response({"error": "Invalid request"}, 400)
-                    return
-                body = json.loads(self.rfile.read(content_length).decode())
-                sudokus = body["sudokus"]
-            except (ValueError, KeyError, TypeError, UnicodeDecodeError):
-                self._record("/solve_batch", t0, error=True)
-                self._send_response({"error": "Invalid request"}, 400)
-                return
-            size = self.p2p_node.engine.spec.size
-            if (
-                not isinstance(sudokus, list)
-                or not 1 <= len(sudokus) <= self.MAX_BATCH
-            ):
-                reason = f"need 1..{self.MAX_BATCH} boards"
-            else:
-                reason = next(
-                    filter(
-                        None, (_board_error(s, size) for s in sudokus)
-                    ),
-                    None,
-                )
-            if reason is not None:
-                logger.info("rejected /solve_batch body: %s", reason)
-                self._record("/solve_batch", t0, error=True)
-                self._send_response({"error": "Invalid request"}, 400)
-                return
-            solutions, mask, info = self.p2p_node.batch_sudoku_solve(sudokus)
-            self._record("/solve_batch", t0)
-            self._send_response(
-                {
-                    "solutions": [
-                        sol.tolist() if ok else None
-                        for sol, ok in zip(solutions, mask)
-                    ],
-                    "solved": int(mask.sum()),
-                    "capped": info["capped"],
-                }
+            post_data = self._read_body(
+                "/solve_batch", t0, max_bytes=self.MAX_BATCH_BYTES
             )
+            if post_data is None:
+                return
+            status, payload, error = solve_batch_route(
+                self.p2p_node, post_data
+            )
+            self._record("/solve_batch", t0, error=error)
+            self._send_response(payload, status)
         else:
+            # unknown POST path: the body was never read — under keep-alive
+            # its bytes would be parsed as the next request's start line,
+            # so this connection must close after the reply
+            self.close_connection = True
             self._send_response({"error": "Invalid endpoint"}, 404)
 
     def do_GET(self):
         if self.path == "/stats":
-            self._send_response(self.p2p_node.get_stats())
+            self._send_response(
+                stats_payload(self.p2p_node, self.expose_serving)
+            )
         elif self.path == "/network":
             self._send_response(self.p2p_node.network_view())
         elif self.path == "/metrics" and self.expose_metrics:
-            m = getattr(self.p2p_node, "metrics", None)
-            body = m.summary() if m is not None else {}
-            # engine health rides along (frontier fallbacks / serving-loop
-            # liveness, engine.health) — route keys all start with "/", so
-            # the extra key can't collide
-            eng = getattr(self.p2p_node, "engine", None)
-            if eng is not None and hasattr(eng, "health"):
-                body["engine"] = eng.health()
-            # membership churn machinery (tombstones / re-dial pool):
-            # same no-collision argument as the engine block
-            m_health = getattr(
-                getattr(self.p2p_node, "membership", None), "health", None
-            )
-            if m_health is not None:
-                body["membership"] = m_health()
-            self._send_response(body)
+            self._send_response(metrics_payload(self.p2p_node))
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)
 
@@ -193,16 +268,40 @@ def make_http_server(
     *,
     expose_metrics: bool = False,
     expose_batch: bool = False,
-) -> ThreadingHTTPServer:
-    handler = type(
-        "BoundHandler",
-        (SudokuHTTPHandler,),
-        {
-            "p2p_node": p2p_node,
-            "expose_metrics": expose_metrics,
-            "expose_batch": expose_batch,
-        },
-    )
-    httpd = ThreadingHTTPServer((host, http_port), handler)
+    expose_serving: bool = False,
+    legacy_transport: bool = False,
+):
+    """Default: the lean keep-alive transport (net/fastserve.py) — a deep
+    accept queue and ~an order of magnitude less pure-Python per request
+    than http.server, feeding the coalescer the concurrency it batches.
+    ``legacy_transport=True`` restores the seed's serving transport —
+    stock http.server speaking HTTP/1.0 (a connection per request) on the
+    stock 5-deep accept queue — for A/B measurement (bench.py --mode
+    concurrent drives both under identical load). Both return the same
+    lifecycle surface: serve_forever() / shutdown() / server_address."""
+    if legacy_transport:
+        handler = type(
+            "BoundHandler",
+            (SudokuHTTPHandler,),
+            {
+                "p2p_node": p2p_node,
+                "expose_metrics": expose_metrics,
+                "expose_batch": expose_batch,
+                "expose_serving": expose_serving,
+                "protocol_version": "HTTP/1.0",
+            },
+        )
+        httpd = ThreadingHTTPServer((host, http_port), handler)
+    else:
+        from .fastserve import FastHTTPServer
+
+        httpd = FastHTTPServer(
+            p2p_node,
+            host,
+            http_port,
+            expose_metrics=expose_metrics,
+            expose_batch=expose_batch,
+            expose_serving=expose_serving,
+        )
     logger.info("HTTP server on %s:%s", host, http_port)
     return httpd

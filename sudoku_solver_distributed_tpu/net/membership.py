@@ -56,23 +56,40 @@ threads, unlocked).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Set
 
 from .wire import valid_address
 
+logger = logging.getLogger(__name__)
 
 
 class Membership:
-    def __init__(self, node_id: str, tombstone_ttl_s: float = 30.0):
+    def __init__(
+        self,
+        node_id: str,
+        tombstone_ttl_s: float = 30.0,
+        max_known_addresses: int = 4096,
+    ):
         self.node_id = node_id
         self.tombstone_ttl_s = tombstone_ttl_s
+        # Hostile-flood memory bound (ADVICE r5 low): ingress validation
+        # keeps garbage out, but a flood of WELL-FORMED fake "host:port"
+        # strings would still grow all_peers and peers_to_reconnect without
+        # limit (the grow-only union merge never removes, and the re-dial
+        # pool remembers every address it sees). Past this many distinct
+        # addresses, merge_all_peers refuses new ones (logged); remembered
+        # non-view addresses additionally age out past the same 10x-TTL
+        # horizon node._reap_dead_neighbors uses for _last_seen.
+        self.max_known_addresses = max_known_addresses
         self._lock = threading.Lock()
         self.peers_out: Set[str] = set()   # peers that dialed us
         self.peers_in: Set[str] = set()    # peers we dialed
         self.all_peers: Dict[str, List[str]] = {}
         self.peers_to_reconnect: Dict[str, bool] = {}
+        self._remembered_at: Dict[str, float] = {}  # re-dial pool refresh time
         self._tombstones: Dict[str, float] = {}  # addr -> monotonic expiry
         self._buried_at: Dict[str, float] = {}   # addr -> first burial time
         self._stale_seen: List[str] = []         # pushback queue (drain_stale)
@@ -88,6 +105,7 @@ class Membership:
             self._buried_at.pop(address, None)  # revival resets burial age
             self.peers_out.add(address)
             self.peers_to_reconnect[address] = True
+            self._remembered_at[address] = time.monotonic()
 
     def on_connected(self, address: str) -> None:
         """Inbound ``connected`` (our dial was accepted)."""
@@ -96,6 +114,7 @@ class Membership:
             self._buried_at.pop(address, None)
             self.peers_in.add(address)
             self.peers_to_reconnect[address] = True
+            self._remembered_at[address] = time.monotonic()
             self.all_peers[address] = [self.node_id]
 
     def mark_alive(self, address: str) -> None:
@@ -114,6 +133,15 @@ class Membership:
         now = time.monotonic()
         with self._lock:
             self._purge_tombstones(now)
+            self._gc_remembered_locked(now)
+            # Address budget (ADVICE r5 low): a flood of well-formed fake
+            # addresses must not grow the view without bound. Entries past
+            # the cap are refused wholesale — in a legitimate network the
+            # cap is orders of magnitude above the node count, and a later
+            # flood re-offers anything a hostile burst crowded out.
+            known = self._total_peers_locked()
+            budget = self.max_known_addresses - len(known)
+            refused = 0
             stale = set()
             for parent, children in received.items():
                 if not valid_address(parent) or not isinstance(
@@ -137,40 +165,99 @@ class Membership:
                     # them as re-dial candidates even though there is no
                     # live edge to merge them under (code-review r5)
                     for addr in live_children:
-                        if (
-                            addr != self.node_id
-                            and self.peers_to_reconnect.get(addr) is not True
-                        ):
-                            self.peers_to_reconnect[addr] = True
+                        if addr != self.node_id and self.peers_to_reconnect.get(
+                            addr
+                        ) is not True:
+                            if (
+                                addr in self.peers_to_reconnect
+                                or len(self.peers_to_reconnect)
+                                < self.max_known_addresses
+                            ):
+                                self.peers_to_reconnect[addr] = True
+                                self._remembered_at[addr] = now
                     continue
                 if parent not in self.all_peers:
                     # an entry whose every child was tombstone-filtered is
                     # itself stale — adding {parent: []} would pollute the
                     # view (pruning deletes emptied parents)
                     if live_children or not children:
+                        new = {
+                            a
+                            for a in (parent, *live_children)
+                            if a not in known and a != self.node_id
+                        }
+                        if len(new) > budget:
+                            refused += len(new)
+                            continue
+                        budget -= len(new)
+                        known |= new
                         self.all_peers[parent] = list(live_children)
                         changed = True
                 else:
-                    merged = sorted(
-                        set(self.all_peers[parent]) | set(live_children)
-                    )
-                    if merged != sorted(self.all_peers[parent]):
-                        self.all_peers[parent] = merged
+                    have = set(self.all_peers[parent])
+                    allowed = []
+                    for addr in live_children:
+                        if addr in have:
+                            continue
+                        if addr in known or addr == self.node_id:
+                            allowed.append(addr)
+                        elif budget > 0:
+                            budget -= 1
+                            known.add(addr)
+                            allowed.append(addr)
+                        else:
+                            refused += 1
+                    if allowed:
+                        self.all_peers[parent] = sorted(have | set(allowed))
                         changed = True
+            if refused:
+                logger.warning(
+                    "flood merge refused %d new addresses past the "
+                    "%d-address view cap",
+                    refused,
+                    self.max_known_addresses,
+                )
             self._stale_seen.extend(
                 a for a in sorted(stale) if a not in self._stale_seen
             )
             # revive liveness flags for any address we can now see, and
             # REMEMBER every address (reconnect_candidate's pool: a node
             # orphaned later must be able to re-dial survivors it only
-            # ever knew transitively, not just its own ex-neighbors)
+            # ever knew transitively, not just its own ex-neighbors).
+            # The view itself is capped above, so this pool's growth from
+            # here is bounded by the same budget.
             for parent, children in self.all_peers.items():
                 for addr in (parent, *children):
                     if addr == self.node_id:
                         continue
+                    self._remembered_at[addr] = now
                     if self.peers_to_reconnect.get(addr) is not True:
                         self.peers_to_reconnect[addr] = True
         return changed
+
+    def _gc_remembered_locked(self, now: float) -> None:
+        """Age out remembered addresses that are neither neighbors nor in
+        the current view and have not been re-attested within 10x the
+        tombstone TTL — the same horizon node._reap_dead_neighbors applies
+        to ``_last_seen``. Without this, every address a hostile flood
+        ever slipped into the re-dial pool (or every long-dead ex-peer)
+        would be remembered forever (ADVICE r5 low); with it the pool
+        self-heals once the flood stops, and the view cap's budget frees
+        back up."""
+        horizon = 10.0 * self.tombstone_ttl_s
+        keep = self._total_peers_locked() | self.peers_in | self.peers_out
+        for addr in list(self.peers_to_reconnect):
+            if addr in keep:
+                continue
+            t0 = self._remembered_at.setdefault(addr, now)
+            if now - t0 > horizon:
+                del self.peers_to_reconnect[addr]
+                del self._remembered_at[addr]
+        # drop orphaned timestamps (address left the pool some other way)
+        for addr in [
+            a for a in self._remembered_at if a not in self.peers_to_reconnect
+        ]:
+            del self._remembered_at[addr]
 
     def drain_stale(self) -> List[str]:
         """Tombstoned addresses observed in incoming floods since the last
@@ -247,6 +334,15 @@ class Membership:
         was our parent (orphan re-join, reference node.py:360-372).
         """
         redial: Optional[str] = None
+        if address == self.node_id:
+            # We can never "depart" from our own view, and tombstoning our
+            # own id would filter US out of every incoming flood merge.
+            # Defense in depth behind the node-level ingress drop of spoofed
+            # self-disconnects (node._on_message): every other path into
+            # on_disconnect (dead-neighbor declarations, relayed deletions)
+            # names a peer, so a self-address here is always hostile or a
+            # bug (ADVICE r5 high).
+            return False, None
         with self._lock:
             now = time.monotonic()
             self._purge_tombstones(now)

@@ -60,6 +60,7 @@ class P2PNode:
         metrics=None,
         fault_injector=None,
         tombstone_ttl_s: Optional[float] = None,
+        serialize_solves: bool = False,
     ):
         self.host = host
         self.port = port
@@ -90,6 +91,11 @@ class P2PNode:
         # master-side task farm state (one solve in flight at a time, like the
         # reference; guarded properly here)
         self._solve_lock = threading.Lock()
+        # seed-fidelity switch (CLI --seed-serving): serialize EVERY request
+        # behind _solve_lock the way the seed did, instead of letting
+        # engine-path requests ride the coalescer concurrently — the A/B
+        # baseline for bench.py --mode concurrent
+        self.serialize_solves = serialize_solves
         self._state_lock = threading.Lock()
         self._solution_event = threading.Condition(self._state_lock)
         self.task_queue: deque = deque()
@@ -202,11 +208,16 @@ class P2PNode:
             self.send_to(peer, msg)
 
     def broadcast_stats(self) -> None:
+        peers = self.membership.neighbors()
+        if not peers:
+            # nothing to gossip to — and this runs once per /solve, so the
+            # snapshot (lock + fold + dict rebuild) is serving hot path
+            return
         snap = self.stats.snapshot()
         msg = wire.stats_msg(
             self.id, self._solved_count, self.engine.validations, snap
         )
-        for peer in self.membership.neighbors():
+        for peer in peers:
             self.send_to(peer, msg)
 
     def get_stats(self) -> wire.Msg:
@@ -327,6 +338,20 @@ class P2PNode:
             self.stats.merge(msg)
 
         elif mtype == "disconnect":
+            if msg["address"] == self.id:
+                # Mirror the connect/connected self-guards above: a spoofed
+                # disconnect naming OUR id would make us prune+tombstone
+                # ourselves and flood disconnect(self.id) to every neighbor
+                # — and since that relay leaves our own socket, it matches
+                # the port-only goodbye exemption and every neighbor honors
+                # it, evicting a live node network-wide for up to 6x
+                # tombstone TTL. One hostile datagram, minutes of flapping
+                # (ADVICE r5 high). Nothing legitimate ever names us: we
+                # only send our own goodbye at shutdown, after recv stops.
+                logger.warning(
+                    "dropping spoofed self-disconnect from %r", source
+                )
+                return
             self._on_disconnect(msg, source=source)
 
         elif mtype == "solve":
@@ -472,15 +497,32 @@ class P2PNode:
         With the frontier engine enabled the mesh race *is* the distributed
         path — it replaces the per-cell peer farm for the request (P2P peers
         still carry membership/stats), the same way the reference's
-        distributed dispatch is its serving path."""
-        with self._solve_lock:
-            peers = [p for p in self.membership.total_peers()]
-            if not peers or self.engine.frontier_enabled:
-                solution, _ = self.engine.solve_one(sudoku)
+        distributed dispatch is its serving path.
+
+        Engine-path requests (no peers, or frontier engine) do NOT
+        serialize behind ``_solve_lock`` anymore: each handler thread
+        enqueues on the engine (whose coalescer merges concurrent requests
+        into one bucketed device call — parallel/coalescer.py) and awaits
+        its future. Only the peer task farm still takes the lock — its
+        master-side queue/active-task state is one-solve-at-a-time by
+        construction (reference semantics)."""
+        peers = [p for p in self.membership.total_peers()]
+        if not peers or self.engine.frontier_enabled:
+            if self.serialize_solves:
+                with self._solve_lock:
+                    solution, _ = self.engine.solve_one(sudoku)
             else:
-                solution = self._farm_solve(sudoku, peers)
+                solution, _ = self.engine.solve_one_async(sudoku).result()
             if solution is not None:
-                self._solved_count += 1
+                with self._state_lock:
+                    self._solved_count += 1
+            self.broadcast_stats()
+            return solution
+        with self._solve_lock:
+            solution = self._farm_solve(sudoku, peers)
+            if solution is not None:
+                with self._state_lock:
+                    self._solved_count += 1
             self.broadcast_stats()
             return solution
 
@@ -490,12 +532,14 @@ class P2PNode:
         gossip behave exactly as len(sudokus) sequential solves would:
         solved boards add to this node's solved count, the engine bills
         its validation sweeps, and one stats broadcast follows."""
-        with self._solve_lock:
-            # solve_batch_np owns the int32 conversion (engine.py)
-            solutions, mask, info = self.engine.solve_batch_np(sudokus)
+        # solve_batch_np is thread-safe (engine-internal counter lock); the
+        # node-side counter shares _state_lock with the engine-path solves
+        # now that /solve requests no longer serialize behind _solve_lock
+        solutions, mask, info = self.engine.solve_batch_np(sudokus)
+        with self._state_lock:
             self._solved_count += int(mask.sum())
-            self.broadcast_stats()
-            return solutions, mask, info
+        self.broadcast_stats()
+        return solutions, mask, info
 
     def _farm_solve(self, sudoku, peers: List[str]) -> Optional[list]:
         board = [list(r) for r in sudoku]

@@ -79,6 +79,16 @@ class SudokuSolver:
                 row[:] = solved_row
         return solution
 
+    def solve_sudoku_async(self, sudoku):
+        """Extension (not a reference surface): enqueue one board on the
+        engine's request coalescer and return a ``concurrent.futures``
+        Future resolving to ``(solution | None, info)``. Concurrent callers
+        share one bucketed device call (parallel/coalescer.py) instead of
+        each paying a batch-1 dispatch; unlike ``solve_sudoku`` the input
+        is never mutated and ``solved_puzzles`` is not incremented (the
+        engine's own counters still account the work)."""
+        return self._engine.solve_one_async(sudoku, frontier=False)
+
     def is_valid_move(self, board, row: int, col: int, num: int) -> bool:
         """Reference node.py:42-60 — including its quirk that a fully valid
         board short-circuits True before looking at (row, col, num)."""

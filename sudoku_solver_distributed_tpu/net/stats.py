@@ -118,3 +118,26 @@ class StatsGossip:
     # reports "the whole network since it started" (reference README.md:46);
     # their validations happened and the totals stay monotone. This matches
     # the reference's observed behavior (SURVEY.md §3.5).
+
+
+def serving_snapshot(engine) -> Msg:
+    """The opt-in ``serving`` block of GET /stats (CLI ``--serving-stats``).
+
+    Operator view of the request-coalescing scheduler
+    (parallel/coalescer.py): realized batch-fill (boards per device call —
+    the multi-tenant throughput the bucket compilations were paid for),
+    current/max queue depth, and request wait times against the configured
+    max-wait budget. Off by default so the reference's /stats body stays
+    byte-identical ({"all", "nodes"} only — the same opt-in contract as
+    /metrics and /solve_batch).
+    """
+    out = {
+        "coalesce": bool(getattr(engine, "coalesce", False)),
+        "batches": 0,
+        "boards": 0,
+        "batch_fill_avg": 0.0,
+    }
+    co = getattr(engine, "_coalescer", None)
+    if co is not None:
+        out.update(co.stats())
+    return out

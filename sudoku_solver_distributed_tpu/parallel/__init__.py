@@ -9,12 +9,18 @@ The reference's parallelism is a master/worker task farm over UDP peers
     DFS subtrees sharded across chips, racing to a solution with an
     early-exit collective — this workload's analog of sequence/context
     parallelism (SURVEY.md §5: the search frontier is the sequence axis).
+
+Feeding both from live traffic: **request coalescing** (coalescer.py) —
+concurrent single-board requests micro-batched into the engine's warm
+buckets, the continuous-batching layer between the HTTP surface and the
+device programs.
 """
 
 from .mesh import default_mesh, data_sharding
 from .shard import make_sharded_solver
 from .frontier import frontier_solve, seed_frontier, state_handoff_frontier
 from .serving_loop import FrontierServingLoop
+from .coalescer import BatchCoalescer
 
 __all__ = [
     "default_mesh",
@@ -24,4 +30,5 @@ __all__ = [
     "seed_frontier",
     "state_handoff_frontier",
     "FrontierServingLoop",
+    "BatchCoalescer",
 ]

@@ -1,0 +1,39 @@
+"""JAX API compatibility shims for the parallel layer.
+
+``shard_map`` moved twice across the JAX versions this repo meets in the
+wild: modern releases expose ``jax.shard_map`` with a ``check_vma=`` flag,
+while 0.4.x only has ``jax.experimental.shard_map.shard_map`` whose
+equivalent flag is named ``check_rep=``. The seed pinned the new spelling
+and lost the whole mesh layer (racer + sharded solver, 16 test failures)
+on 0.4.37. ONE shim here keeps every call site on the modern signature.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+    _CHECK_KWARG = "check_vma"
+else:  # jax 0.4.x: experimental module, check_rep instead of check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _CHECK_KWARG = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` signature on every supported JAX.
+
+    Accepts the modern ``check_vma=`` keyword and forwards it under
+    whichever name the installed JAX understands (``check_rep`` on 0.4.x —
+    the flag gates the same replication/varying-manual-axes typecheck in
+    both generations). Usable directly or via ``functools.partial`` as a
+    decorator, exactly like the real thing.
+    """
+    return _shard_map_impl(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KWARG: check_vma},
+    )

@@ -30,6 +30,7 @@ from ..ops import BoardSpec, SPEC_9
 from ..ops.propagate import analyze
 from ..ops.encode import mask_to_value
 from ..ops import solver as S
+from .compat import shard_map
 from .mesh import default_mesh
 
 
@@ -300,7 +301,7 @@ def _make_racer_cached(
     from jax.sharding import PartitionSpec as P
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("data"),),
         out_specs=P(),

@@ -18,6 +18,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import BoardSpec, SPEC_9, solve_batch
+from .compat import shard_map
 
 
 def make_sharded_solver(
@@ -45,7 +46,7 @@ def make_sharded_solver(
     data_spec = P("data")
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(data_spec,),
         out_specs=(data_spec, data_spec, P()),

@@ -14,6 +14,16 @@ touching the byte-identical HTTP/UDP surfaces:
     (the serving path wires it to a ``--profile-dir`` CLI flag).
   * ``annotate`` — ``jax.profiler.TraceAnnotation`` passthrough so engine
     phases (warmup, bucket solve, frontier race) show up as named spans.
+
+Span naming contract for the coalesced serving path (parallel/coalescer.py),
+so a ``--profile-dir`` trace separates host scheduling from device time:
+
+  * ``coalescer_dispatch_b<N>`` — dispatcher thread: stack/pad a batch of N
+    requests and async-enqueue the device call (host-side cost of batching);
+  * ``coalescer_device_wait`` — completion thread: blocked fetching the
+    in-flight batch (device compute + transfer; overlaps the NEXT batch's
+    dispatch span when the pipeline is full — that overlap is the
+    double-buffering working).
 """
 
 from __future__ import annotations

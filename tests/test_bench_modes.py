@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -83,6 +85,53 @@ def test_farm_mode_smoke():
     check_artifact(artifact)
     assert artifact["metric"] == "p50_solve_http_3node_farm_5hole9x9"
     assert "complete" in stderr or "completeness" in stderr
+
+
+def test_unknown_mode_flag_exits_with_usage():
+    """``--mode`` (the CLI spelling of BENCH_MODE) must reject typos loudly
+    instead of silently running the default throughput path."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode", "bogus"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "unknown mode" in proc.stderr
+
+
+@pytest.mark.slow
+def test_concurrent_mode_smoke():
+    """The coalescer A/B harness end-to-end at toy scale: two node phases
+    (seed-serialized, coalesced), one JSON line with the speedup ratio and
+    the realized batch-fill. Tiny load — this checks plumbing, not the
+    ≥3x acceptance ratio (that needs the real 64-client run)."""
+    env = dict(
+        os.environ,
+        BENCH_CONCURRENT_CLIENTS="8",
+        BENCH_CONCURRENT_SECS="2",
+        BENCH_CONCURRENT_HOLES="40",
+        BENCH_PLATFORM="cpu",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode", "concurrent"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=480,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, proc.stdout
+    artifact = json.loads(json_lines[0])
+    check_artifact(artifact)
+    assert artifact["metric"] == "concurrent_solve_puzzles_per_sec_8c_9x9"
+    assert artifact["unit"] == "puzzles/s"
+    assert artifact["serialized_pps"] > 0
+    assert artifact["batch_fill_avg"] is not None
 
 
 def test_throughput_retry_survives_init_hang(tmp_path):

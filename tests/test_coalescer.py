@@ -1,0 +1,258 @@
+"""The request-coalescing micro-batch scheduler (parallel/coalescer.py):
+concurrent single-board requests share one bucketed device call, results
+fan back to the right requester, a lone request dispatches after max-wait,
+shutdown drains cleanly, and the coalesced path stays within the latency
+contract of the direct path (ISSUE 1 acceptance)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import generate_batch
+from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+from sudoku_solver_distributed_tpu.parallel.coalescer import BatchCoalescer
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1, 8))
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def boards():
+    # 16 distinct easy boards (clue patterns differ, so a result fanned to
+    # the wrong requester fails the clue-preservation check below)
+    return generate_batch(16, 40, seed=7)
+
+
+def _assert_solves(board, solution):
+    sol = np.asarray(solution)
+    clues = np.asarray(board) != 0
+    assert (sol[clues] == np.asarray(board)[clues]).all()
+    assert oracle_is_valid_solution(sol.tolist())
+
+
+def test_concurrent_submits_coalesce_into_buckets(engine, boards, monkeypatch):
+    """N concurrent requests produce ≤ ceil(N/bucket) device dispatches
+    (the whole point: one device call per bucket, not per request), and
+    every requester gets a solution to ITS OWN board back."""
+    calls = []
+    real_dispatch = engine._dispatch_padded
+    monkeypatch.setattr(
+        engine,
+        "_dispatch_padded",
+        lambda b: (calls.append(b.shape[0]), real_dispatch(b))[1],
+    )
+    # long max-wait: every thread enqueues well inside the window, so the
+    # dispatcher drains full buckets instead of racing the submitters
+    co = BatchCoalescer(engine, max_wait_s=0.25)
+    try:
+        futures = [None] * len(boards)
+        barrier = threading.Barrier(len(boards))
+
+        def post(i):
+            barrier.wait()
+            futures[i] = co.submit(boards[i])
+
+        threads = [
+            threading.Thread(target=post, args=(i,))
+            for i in range(len(boards))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, fut in enumerate(futures):
+            solution, info = fut.result(timeout=60)
+            assert solution is not None, info
+            _assert_solves(boards[i], solution)
+    finally:
+        co.close()
+    max_bucket = engine.buckets[-1]
+    assert len(calls) <= -(-len(boards) // max_bucket), calls
+    assert sum(calls) >= len(boards)
+    st = co.stats()
+    assert st["boards"] == len(boards)
+    assert st["batch_fill_avg"] > 1  # realized multi-tenant batching
+    assert st["batch_fill_max"] == max_bucket
+
+
+def test_lone_request_dispatches_after_max_wait(engine, boards):
+    """A request with no co-riders must not wait for a full bucket: the
+    batch dispatches once max_wait has passed since its arrival."""
+    co = BatchCoalescer(engine, max_wait_s=0.05)
+    try:
+        t0 = time.monotonic()
+        solution, info = co.submit(boards[0]).result(timeout=60)
+        elapsed = time.monotonic() - t0
+        assert solution is not None, info
+        _assert_solves(boards[0], solution)
+        # generous CI ceiling: max_wait (0.05 s) + a warm batch-1 solve +
+        # scheduling noise — nowhere near a hang waiting for co-riders
+        assert elapsed < 5.0, elapsed
+        assert co.stats()["batch_fill_last"] == 1
+    finally:
+        co.close()
+
+
+def test_burst_absorption_extends_past_max_wait(engine, boards, monkeypatch):
+    """Requests that keep ARRIVING at the max-wait deadline are absorbed
+    into one bucket instead of dispatched as a dribble of tiny batches:
+    8 submits spaced 50 ms apart (each inside the 250 ms quiescence
+    window) coalesce into ONE bucket-8 device call even though they span
+    20× the 20 ms max-wait."""
+    calls = []
+    real_dispatch = engine._dispatch_padded
+    monkeypatch.setattr(
+        engine,
+        "_dispatch_padded",
+        lambda b: (calls.append(b.shape[0]), real_dispatch(b))[1],
+    )
+    co = BatchCoalescer(
+        engine, max_wait_s=0.02, quiescence_s=0.25, burst_wait_s=30.0
+    )
+    try:
+        futures = []
+        for i in range(8):
+            futures.append(co.submit(boards[i]))
+            time.sleep(0.05)
+        for i, fut in enumerate(futures):
+            solution, info = fut.result(timeout=60)
+            assert solution is not None, info
+            _assert_solves(boards[i], solution)
+    finally:
+        co.close()
+    assert calls == [8], calls
+
+
+def test_burst_absorption_is_capped(engine, boards):
+    """The absorb extension is bounded by burst_wait_s past the OLDEST
+    pending request: a submit stream that never goes quiescent still gets
+    dispatched in slices instead of waiting for a full bucket."""
+    co = BatchCoalescer(
+        engine, max_wait_s=0.02, quiescence_s=10.0, burst_wait_s=0.05
+    )
+    try:
+        futures = []
+        for i in range(8):
+            futures.append(co.submit(boards[i]))
+            time.sleep(0.03)
+        for i, fut in enumerate(futures):
+            solution, info = fut.result(timeout=60)
+            assert solution is not None, info
+            _assert_solves(boards[i], solution)
+    finally:
+        co.close()
+    # 8 arrivals over ~210 ms against a 50 ms cap: at least two dispatches
+    # (no-cap behavior would absorb all 8 into one; exact slicing depends
+    # on scheduler timing)
+    assert co.stats()["batches"] >= 2
+
+
+def test_max_batch_caps_drain_size(engine, boards, monkeypatch):
+    """coalesce_max_batch bounds boards per device call below the largest
+    bucket (the CPU fallback's SIMD sweet spot — engine.py rationale):
+    16 burst submits through a cap of 4 dispatch as ≥4 calls of ≤4."""
+    calls = []
+    real_dispatch = engine._dispatch_padded
+    monkeypatch.setattr(
+        engine,
+        "_dispatch_padded",
+        lambda b: (calls.append(b.shape[0]), real_dispatch(b))[1],
+    )
+    co = BatchCoalescer(engine, max_wait_s=0.25, max_batch=4)
+    try:
+        futures = [co.submit(b) for b in boards]
+        for b, fut in zip(boards, futures):
+            solution, info = fut.result(timeout=60)
+            assert solution is not None, info
+            _assert_solves(b, solution)
+    finally:
+        co.close()
+    st = co.stats()
+    assert st["boards"] == len(boards)
+    assert max(calls) <= 4, calls
+    assert len(calls) >= len(boards) // 4
+
+
+def test_wrong_shape_board_fails_its_caller_not_the_batch(engine, boards):
+    """A wrong-shape board must raise synchronously at submit() — not
+    reach the dispatcher's np.stack, where it would poison every
+    co-riding request's future with the same exception."""
+    co = BatchCoalescer(engine, max_wait_s=0.05)
+    try:
+        good = co.submit(boards[0])
+        with pytest.raises(ValueError):
+            co.submit(np.zeros((16, 16), np.int32))
+        solution, info = good.result(timeout=60)
+        assert solution is not None, info
+        _assert_solves(boards[0], solution)
+    finally:
+        co.close()
+
+
+def test_cancelled_future_does_not_wedge_the_pipeline(engine, boards):
+    """A caller may cancel() its future while its batch is in flight
+    (futures are never marked running, so cancel always succeeds on a
+    pending one); the completer's fan-out must survive it — an unguarded
+    set_result would raise InvalidStateError, kill the completer thread,
+    and deadlock every batch after inflight_depth more dispatches."""
+    co = BatchCoalescer(engine, max_wait_s=0.05)
+    try:
+        co.submit(boards[0]).cancel()  # may lose the race; either is fine
+        # more follow-ups than inflight_depth: a dead completer would
+        # leave these futures unresolved forever
+        for b in boards[:4]:
+            solution, info = co.submit(b).result(timeout=30)
+            assert solution is not None, info
+    finally:
+        co.close()
+
+
+def test_close_drains_pending_queue(engine, boards):
+    """Clean shutdown contract: every future submitted before close()
+    resolves (the dispatcher drains the queue before stopping), and
+    submits after close() are refused."""
+    co = BatchCoalescer(engine, max_wait_s=0.5)
+    futures = [co.submit(b) for b in boards]
+    co.close()
+    for b, fut in zip(boards, futures):
+        assert fut.done()
+        solution, info = fut.result(timeout=0)
+        assert solution is not None, info
+        _assert_solves(b, solution)
+    with pytest.raises(RuntimeError):
+        co.submit(boards[0])
+    co.close()  # idempotent
+
+
+def test_single_request_latency_within_contract(engine, boards):
+    """ISSUE 1 acceptance: the coalescer's max-wait keeps a lone request's
+    p50 within ~2 ms (the default budget) of the direct solve path —
+    asserted with a generous CI margin on top."""
+    board = boards[0]
+    arr = np.asarray(board, np.int32)
+    # warm both paths out of the measurement
+    engine.solve_batch_np(arr[None])
+    assert engine.coalesce
+    engine.solve_one(board.tolist())
+
+    direct, coalesced = [], []
+    for _ in range(21):
+        t0 = time.perf_counter()
+        engine.solve_batch_np(arr[None])
+        direct.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        solution, _ = engine.solve_one(board.tolist())
+        coalesced.append(time.perf_counter() - t0)
+        assert solution is not None
+    delta = float(np.percentile(coalesced, 50) - np.percentile(direct, 50))
+    # budget is 2 ms; the margin absorbs CI scheduler noise, not a design
+    # regression (a full-bucket wait or a lost wakeup would be >> this)
+    assert delta < 0.060, (delta, np.percentile(direct, 50))

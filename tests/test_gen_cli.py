@@ -66,3 +66,25 @@ def test_gen_cli_extensions_size_seed_unique():
     hexa = run("100", "--size", "16", "--seed", "3")
     assert len(hexa) == 16 and all(len(r) == 16 for r in hexa)
     assert sum(1 for row in hexa for v in row if v == 0) == 100
+
+
+def test_gen_cli_rejects_unknown_arguments():
+    """ADVICE r5 low: leftover argv tokens (a typo like '--sizes 16' or
+    '--uniq') must exit with usage instead of silently generating a
+    default 9x9 non-unique puzzle."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "gen.py"), *args],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+
+    for argv in (("30", "--sizes", "16"), ("30", "--uniq"), ("30", "extra")):
+        out = run(*argv)
+        assert out.returncode != 0, argv
+        assert "unknown argument" in out.stderr and "usage:" in out.stderr
+    # known flags still work together (no false positives from the check)
+    ok = run("30", "--seed", "7", "--unique")
+    assert ok.returncode == 0, ok.stderr[-2000:]

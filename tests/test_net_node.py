@@ -163,6 +163,58 @@ def test_disconnect_prunes_topology(engine):
         c.stop()
 
 
+def test_spoofed_self_disconnect_dropped(engine):
+    """ADVICE r5 high: a hostile datagram ``disconnect{address: victim_id}``
+    sent TO the victim must be dropped at ingress. Without the guard the
+    victim prunes+tombstones itself and floods disconnect(self.id) from its
+    own socket — which matches the port-only goodbye exemption, so every
+    neighbor honors it and a live node is evicted network-wide for up to 6x
+    tombstone TTL. One datagram, minutes of flapping."""
+    from sudoku_solver_distributed_tpu.net import wire
+
+    c = Cluster(3, engine)
+    try:
+        assert c.wait_converged()
+        victim = c.nodes[0]
+        attacker = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            attacker.sendto(
+                wire.encode_msg(wire.disconnect_msg(victim.id)),
+                ("127.0.0.1", victim.port),
+            )
+        finally:
+            attacker.close()
+        # the victim must keep itself in its own view AND stay visible to
+        # its peers; give the (dropped) datagram plus any erroneous relay
+        # flood ample time to have taken effect if the guard were missing
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            assert c.wait_converged(timeout=1.0), [
+                n.membership.all_peers for n in c.nodes
+            ]
+            time.sleep(0.2)
+        assert victim.id not in victim.membership._tombstones
+    finally:
+        c.stop()
+
+
+def test_membership_self_disconnect_noop(engine):
+    """Defense in depth behind the ingress drop: Membership.on_disconnect
+    must be a no-op for the node's own id — never prune the view, never
+    tombstone self (a self-tombstone would filter us out of every incoming
+    flood merge)."""
+    from sudoku_solver_distributed_tpu.net.membership import Membership
+
+    m = Membership("127.0.0.1:9001")
+    m.on_connect("127.0.0.1:9002")
+    m.merge_all_peers({"127.0.0.1:9001": ["127.0.0.1:9002"]})
+    before = m.network_view()
+    changed, redial = m.on_disconnect("127.0.0.1:9001")
+    assert changed is False and redial is None
+    assert m.network_view() == before
+    assert "127.0.0.1:9001" not in m._tombstones
+
+
 def test_http_surface(engine, readme_puzzle):
     c = Cluster(2, engine)
     httpd = None
@@ -233,6 +285,83 @@ def test_http_surface(engine, readme_puzzle):
             assert False, "expected 400"
         except urllib.error.HTTPError as e:
             assert e.code == 400
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        c.stop()
+
+
+def test_http_keepalive_reuse_and_desync_guard(engine):
+    """The serving transport is HTTP/1.1 keep-alive (the coalescer's
+    concurrency feeder): two requests must ride one connection, and a
+    handler that bails WITHOUT consuming the request body (unknown POST
+    path) must close the connection — leftover body bytes would be parsed
+    as the next request's start line."""
+    import http.client
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    board = generate_batch(1, 5, seed=3)[0].tolist()
+    body = json.dumps({"sudoku": board}).encode()
+    c = Cluster(1, engine)
+    httpd = None
+    try:
+        http_port = free_port()
+        httpd = make_http_server(c.nodes[0], "127.0.0.1", http_port)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        conn = http.client.HTTPConnection("127.0.0.1", http_port, timeout=30)
+        for _ in range(2):  # same socket both times
+            conn.request(
+                "POST", "/solve", body, {"Content-Type": "application/json"}
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert not resp.will_close
+            solved = json.loads(resp.read())
+            assert all(all(v != 0 for v in row) for row in solved)
+        # unknown POST path, body never read server-side: the reply must
+        # carry Connection: close (keep-alive would desync on the unread
+        # bytes) — and a fresh connection must work fine afterwards
+        conn.request(
+            "POST", "/bogus", body, {"Content-Type": "application/json"}
+        )
+        resp = conn.getresponse()
+        assert resp.status == 404
+        assert resp.will_close
+        resp.read()
+        conn.close()
+        conn = http.client.HTTPConnection("127.0.0.1", http_port, timeout=30)
+        conn.request(
+            "POST", "/solve", body, {"Content-Type": "application/json"}
+        )
+        assert conn.getresponse().status == 200
+        # a chunked body is never consumed by the Content-Length framing
+        # the handler uses: it must answer 400 AND close, or the chunk
+        # bytes would be parsed as the next request's start line
+        conn.close()
+        conn = http.client.HTTPConnection("127.0.0.1", http_port, timeout=30)
+        conn.putrequest("POST", "/solve")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        conn.send(b"%x\r\n%s\r\n0\r\n\r\n" % (len(body), body))
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert resp.will_close
+        resp.read()
+        conn.close()
+        # malformed Content-Length: the body length is unknowable, so the
+        # connection cannot be reframed — same 400 + close contract
+        conn = http.client.HTTPConnection("127.0.0.1", http_port, timeout=30)
+        conn.putrequest("POST", "/solve")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", "abc")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert resp.will_close
+        resp.read()
+        conn.close()
     finally:
         if httpd is not None:
             httpd.shutdown()

@@ -102,6 +102,43 @@ def test_liveness_flag_revived_on_direct_contact_not_stale_flood():
     assert m.peers_to_reconnect[B] is True
 
 
+def test_flood_cap_bounds_view_growth():
+    """ADVICE r5 low: a hostile flood of WELL-FORMED fake addresses must
+    not grow all_peers / peers_to_reconnect without bound — past the cap,
+    merge_all_peers refuses new addresses (the grow-only union merge and
+    the re-dial pool are otherwise both unbounded)."""
+    m = Membership(C, max_known_addresses=8)
+    assert m.merge_all_peers({A: [B]}) is True  # legit merge under the cap
+    flood = {f"h:{8000 + i}": [f"h:{9000 + i}"] for i in range(100)}
+    m.merge_all_peers(flood)
+    assert len(m.total_peers()) <= 8
+    assert len(m.peers_to_reconnect) <= 8
+    # children appended to an EXISTING parent are budgeted too
+    m.merge_all_peers({A: [f"h:{9500 + i}" for i in range(100)]})
+    assert len(m.total_peers()) <= 8
+    # the legit pre-flood edge survived
+    assert B in m.all_peers[A]
+
+
+def test_remembered_pool_ages_out():
+    """Remembered addresses that are neither neighbors nor in the current
+    view age out past 10x the tombstone TTL (the _last_seen GC horizon),
+    so the re-dial pool self-heals after churn or a hostile flood instead
+    of growing forever."""
+    import time as _time
+
+    m = Membership(C, tombstone_ttl_s=0.01)  # horizon = 0.1 s
+    m.merge_all_peers({A: [B, D]})
+    m.on_disconnect(B)  # B leaves the view; pool keeps it (flag False)
+    assert B in m.peers_to_reconnect
+    m.merge_all_peers({})  # GC pass stamps B's age clock
+    _time.sleep(0.15)
+    m.merge_all_peers({})  # past the horizon: aged out
+    assert B not in m.peers_to_reconnect
+    # A is still in the view — never aged out, whatever its silence
+    assert A in m.peers_to_reconnect
+
+
 def make_gossip(node_id, counters=(0, 0)):
     state = {"c": counters}
     g = StatsGossip(node_id, lambda: state["c"])

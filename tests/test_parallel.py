@@ -110,3 +110,38 @@ def test_frontier_accepts_staged_depth_tuple(readme_puzzle):
     )
     assert sol is not None
     assert info["validations"] > 0
+
+
+def test_shard_map_compat_builds_racer_on_cpu():
+    """Regression for the jax-0.4.37 breakage: ``jax.shard_map`` does not
+    exist there, and the seed's direct references killed the whole mesh
+    layer (racer + sharded solver — 16 failures). The compat shim
+    (parallel/compat.py) must build and RUN the racer on whatever JAX is
+    installed, under the CPU backend the suite forces."""
+    from sudoku_solver_distributed_tpu.parallel import frontier
+
+    mesh = default_mesh(jax.devices()[:2])
+    racer = frontier._make_racer(mesh, SPEC_9, 4096, None, False, 1, None)
+    pad = np.broadcast_to(frontier._unsat_pad(SPEC_9), (4, 9, 9))
+    solution, *_ = racer(jnp.asarray(pad))
+    # every seeded state was the unsat pad: the race must terminate and
+    # report no solution (an all-zeros extraction row)
+    assert not np.asarray(solution).any()
+
+
+def test_shard_map_compat_signature():
+    """The shim accepts the modern ``check_vma=`` spelling regardless of the
+    installed JAX's own kwarg name, both directly and via partial()."""
+    from functools import partial as _partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from sudoku_solver_distributed_tpu.parallel.compat import shard_map
+
+    mesh = default_mesh(jax.devices()[:2])
+    fn = _partial(
+        shard_map, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        check_vma=False,
+    )(lambda x: x + 1)
+    out = jax.jit(fn)(jnp.zeros((4, 3), jnp.int32))
+    assert bool((np.asarray(out) == 1).all())
