@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -97,6 +98,12 @@ class SolverEngine:
         SIMD sweet spot — measured hard-corpus boards/s on 2 cores:
         batch-1 552, batch-8 2758, batch-64 854. Serving benches cap at
         8 on CPU (bench.py --mode concurrent).
+      coalesce_adaptive: scale the three coalescer wait budgets with the
+        measured arrival rate (serving/load.AdaptiveWaitPolicy): the
+        configured values become CAPS — near-zero wait when idle (a lone
+        request dispatches immediately, strictly better latency than the
+        fixed budget), the full budgets under load (full buckets). Off by
+        default: fixed budgets, exactly the PR 1 behavior.
 
     All unspecified solver knobs resolve from ops.SERVING_CONFIG, the single
     definition site shared with bench.py and __graft_entry__ — the benched
@@ -127,6 +134,7 @@ class SolverEngine:
         coalesce_burst_wait_s: Optional[float] = None,
         coalesce_inflight_depth: int = 2,
         coalesce_max_batch: Optional[int] = None,
+        coalesce_adaptive: bool = False,
     ):
         if backend not in ("xla", "pallas"):
             raise ValueError(f"unknown engine backend {backend!r}")
@@ -298,6 +306,7 @@ class SolverEngine:
         self.coalesce_burst_wait_s = coalesce_burst_wait_s
         self.coalesce_inflight_depth = coalesce_inflight_depth
         self.coalesce_max_batch = coalesce_max_batch
+        self.coalesce_adaptive = coalesce_adaptive
         self._coalescer = None
         self._coalescer_init_lock = threading.Lock()
         # flips once warmup() has compiled every bucket — observable at
@@ -432,6 +441,15 @@ class SolverEngine:
                 if self._coalescer is None:
                     from .parallel.coalescer import BatchCoalescer
 
+                    wait_policy = None
+                    if self.coalesce_adaptive:
+                        from .serving.load import AdaptiveWaitPolicy
+
+                        wait_policy = AdaptiveWaitPolicy(
+                            max_wait_s=self.coalesce_max_wait_s,
+                            quiescence_s=self.coalesce_quiescence_s,
+                            burst_wait_s=self.coalesce_burst_wait_s,
+                        )
                     self._coalescer = BatchCoalescer(
                         self,
                         max_wait_s=self.coalesce_max_wait_s,
@@ -439,6 +457,7 @@ class SolverEngine:
                         burst_wait_s=self.coalesce_burst_wait_s,
                         inflight_depth=self.coalesce_inflight_depth,
                         max_batch=self.coalesce_max_batch,
+                        wait_policy=wait_policy,
                     )
         return self._coalescer
 
@@ -957,6 +976,7 @@ class SolverEngine:
         board: Sequence[Sequence[int]],
         *,
         frontier: Optional[bool] = None,
+        deadline_s: Optional[float] = None,
     ):
         """``solve_one`` returning a ``concurrent.futures.Future``.
 
@@ -967,6 +987,13 @@ class SolverEngine:
         bypass the coalescer and run inline in the calling thread: the race
         occupies the whole mesh by design and must not stall the bucket
         pipeline behind it.
+
+        ``deadline_s`` (absolute ``time.monotonic()``, from the admission
+        layer — serving/admission.py): a coalesced request still queued
+        past it is dropped at batch formation and the future raises
+        DeadlineExceeded; inline paths check it once before solving (work
+        already started is never abandoned — the deadline guards queue
+        wait, not service time).
         """
         from concurrent.futures import Future
 
@@ -977,9 +1004,15 @@ class SolverEngine:
             else (frontier and self.frontier_enabled)
         )
         if self.coalesce and not use_frontier:
-            return self.coalescer.submit(arr)
+            return self.coalescer.submit(arr, deadline_s)
         fut: Future = Future()
         try:
+            if deadline_s is not None and time.monotonic() > deadline_s:
+                from .serving.admission import DeadlineExceeded
+
+                raise DeadlineExceeded(
+                    "deadline expired before the solve started"
+                )
             fut.set_result(self.solve_one(board, frontier=frontier))
         except BaseException as e:  # noqa: BLE001 — deliver through the future
             fut.set_exception(e)

@@ -37,6 +37,19 @@ Extensions (defaults preserve reference behavior):
                 seconds of neighbor silence before a crash is declared (the
                 gossip heartbeat); 0 restores the reference's graceful-only
                 failure model
+  --admission-capacity / --default-deadline-ms
+                overload control plane (serving/admission.py): bounded
+                pending budget and per-request deadlines (X-Deadline-Ms
+                header); overload answers 429 + Retry-After instead of
+                queueing without bound, and expired requests are dropped
+                before the device runs them. Both default off
+  --adaptive-coalesce
+                scale the coalescer's wait budgets with the measured
+                arrival rate (near-zero when idle, the configured caps
+                under load — serving/load.py)
+  --http-workers
+                bounded connection-worker pool for the serving transport
+                (net/fastserve.py; default 128)
   --coordinator / --num-hosts / --host-id
                 multi-host mode: initialize jax.distributed against the
                 coordinator ("host:port") so the engine's mesh spans a pod
@@ -118,6 +131,40 @@ def build_parser() -> argparse.ArgumentParser:
         default=2.0,
         help="longest a lone request waits for batch co-riders before its "
         "bucket dispatches anyway (default 2 ms)",
+    )
+    parser.add_argument(
+        "--adaptive-coalesce",
+        action="store_true",
+        help="scale the coalescer wait budgets with the measured arrival "
+        "rate (serving/load.py): near-zero wait when idle (a lone request "
+        "dispatches immediately), the configured budgets under load. Off "
+        "by default: fixed budgets",
+    )
+    parser.add_argument(
+        "--admission-capacity",
+        type=int,
+        default=0,
+        help="overload control (serving/admission.py): max admitted "
+        "/solve requests in flight; arrivals past it answer 429 + "
+        "Retry-After instead of queueing without bound. 0 (default) "
+        "disables the pending bound",
+    )
+    parser.add_argument(
+        "--default-deadline-ms",
+        type=float,
+        default=0.0,
+        help="latency budget for /solve requests without an X-Deadline-Ms "
+        "header: requests whose projected queue wait exceeds it are shed "
+        "429 at arrival, and admitted requests that expire waiting are "
+        "dropped before the device runs them. 0 (default) = no deadline",
+    )
+    parser.add_argument(
+        "--http-workers",
+        type=int,
+        default=128,
+        help="connection-worker pool bound for the serving transport "
+        "(net/fastserve.py): a connection flood exhausts a queue, not "
+        "the process thread table",
     )
     parser.add_argument(
         "--coalesce-max-batch",
@@ -239,6 +286,7 @@ def main(argv=None) -> None:
         "coalesce": not (args.no_coalesce or args.seed_serving),
         "coalesce_max_wait_s": args.coalesce_max_wait_ms / 1e3,
         "coalesce_max_batch": args.coalesce_max_batch,
+        "coalesce_adaptive": args.adaptive_coalesce,
     }
     if args.buckets:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
@@ -278,6 +326,14 @@ def main(argv=None) -> None:
             engine.frontier_loop = serving_loop
     from ..utils.profiling import RequestMetrics
 
+    admission = None
+    if args.admission_capacity > 0 or args.default_deadline_ms > 0:
+        from ..serving import AdmissionController
+
+        admission = AdmissionController(
+            capacity=args.admission_capacity,
+            default_deadline_ms=args.default_deadline_ms,
+        )
     node = P2PNode(
         args.host,
         args.s,
@@ -288,6 +344,7 @@ def main(argv=None) -> None:
         failure_timeout=args.failure_timeout,
         metrics=RequestMetrics(),
         serialize_solves=args.seed_serving,
+        admission=admission,
     )
     if args.profile_dir:
         node.engine.profile_dir = args.profile_dir
@@ -302,6 +359,7 @@ def main(argv=None) -> None:
         expose_batch=args.batch_api,
         expose_serving=args.serving_stats,
         legacy_transport=args.seed_serving,
+        max_workers=args.http_workers,
     )
     http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     http_thread.start()

@@ -8,11 +8,14 @@ merges device work so well (parallel/coalescer.py) that the transport
 becomes the bottleneck (measured ~330 puzzles/s flat with http.server vs
 ~2700 boards/s of warm bucket-8 device capacity on 2 cores).
 
-This module is the matching inference-stack transport: a thread per
-connection reading keep-alive requests off one buffered socket file,
-parsing just the request line + the three headers that matter
-(Content-Length / Transfer-Encoding / Connection), and answering from a
-pre-baked header template. Route handling and response BODIES are the
+This module is the matching inference-stack transport: a BOUNDED worker
+pool (lazily grown to ``max_workers``) serving keep-alive connections off
+a shared accept queue — each worker reads requests from one buffered
+socket file, parsing just the request line + the few headers that matter
+(Content-Length / Transfer-Encoding / Connection / X-Deadline-Ms), and
+answers from a pre-baked header template. The pool bound means a
+connection flood exhausts a queue, not the process's thread table
+(serving/admission.py is the request-level guard above it). Route handling and response BODIES are the
 exact shared cores in http_api.py (`solve_route`, `solve_batch_route`,
 `stats_payload`, `metrics_payload`), so the serving surface stays
 byte-identical to the reference no matter which transport carried it —
@@ -32,6 +35,7 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import socket
 import threading
 import time
@@ -40,19 +44,43 @@ from . import http_api
 
 logger = logging.getLogger(__name__)
 
-_REASONS = {200: b"OK", 400: b"Bad Request", 404: b"Not Found"}
+_REASONS = {
+    200: b"OK",
+    400: b"Bad Request",
+    404: b"Not Found",
+    429: b"Too Many Requests",
+}
 # generous cap for any route; /solve_batch's documented bound (http_api)
 _MAX_BODY = http_api.MAX_BATCH_BYTES
 _MAX_LINE = 65536
 _MAX_HEADERS = 100
+# accepted-but-unserved connections the pool will buffer before refusing:
+# past this a connection flood is answered with an immediate close (one
+# accept + one close per flood socket) instead of an unbounded fd pile.
+# Kept SHORT relative to service rate on purpose — this queue sits AHEAD
+# of the admission layer (serving/admission.py reads the request only
+# once a worker picks the connection up), so its depth is invisible
+# pre-admission queueing delay; a deep buffer here would quietly re-add
+# the unbounded-lateness failure mode admission exists to remove
+_CONN_BACKLOG = 256
 
 
 class FastHTTPServer:
     """Drop-in for ThreadingHTTPServer's lifecycle surface:
     ``serve_forever()`` blocks (run it in a thread), ``shutdown()`` stops
     the accept loop, ``server_address`` carries the bound (host, port).
-    In-flight connections are daemon threads; ``shutdown`` stops new
-    accepts and lets live requests finish."""
+
+    Concurrency is a BOUNDED worker pool (``max_workers``, default 128),
+    not a thread per connection: a connection flood can no longer mint
+    threads without limit (PR 1's accept loop would — the one resource
+    the transport handed out unmetered). Workers are spawned lazily, one
+    per accepted connection until the cap, and each then serves
+    keep-alive connections off a shared queue for the server's lifetime —
+    a quiet test server holds a handful of threads, a saturated node
+    holds exactly ``max_workers``. Connections beyond workers+backlog are
+    closed at accept. ``shutdown`` stops new accepts and lets live
+    requests finish (workers are daemon threads polling the shutdown
+    flag)."""
 
     def __init__(
         self,
@@ -63,11 +91,16 @@ class FastHTTPServer:
         expose_metrics: bool = False,
         expose_batch: bool = False,
         expose_serving: bool = False,
+        max_workers: int = 128,
+        conn_backlog: int = _CONN_BACKLOG,
     ):
+        if max_workers < 1:
+            raise ValueError("max_workers must be >= 1")
         self.p2p_node = p2p_node
         self.expose_metrics = expose_metrics
         self.expose_batch = expose_batch
         self.expose_serving = expose_serving
+        self.max_workers = max_workers
         # deep accept queue, same rationale as the old _ThreadingHTTPServer:
         # the stock 5-deep backlog drops SYNs under a 64-client burst and
         # the overflow crawls through 1/3/7 s retransmit backoff
@@ -76,6 +109,10 @@ class FastHTTPServer:
         )
         self.server_address = self._sock.getsockname()
         self._shutdown = False
+        self._conns: "queue.Queue" = queue.Queue(maxsize=max(1, conn_backlog))
+        self._workers = 0
+        self._pool_lock = threading.Lock()
+        self.conns_refused = 0  # flood-closed at accept (benign race on int)
 
     # -- lifecycle ---------------------------------------------------------
     def serve_forever(self) -> None:
@@ -84,11 +121,39 @@ class FastHTTPServer:
                 conn, _addr = self._sock.accept()
             except OSError:
                 break  # listener closed by shutdown()
-            threading.Thread(
-                target=self._serve_connection,
-                args=(conn,),
-                daemon=True,
-            ).start()
+            try:
+                self._conns.put_nowait(conn)
+            except queue.Full:
+                # workers saturated AND the hand-off queue full: refuse
+                # rather than buffer without bound — the client sees an
+                # immediate close/RST and can back off, instead of a
+                # socket that hangs until some keep-alive slot frees
+                self.conns_refused += 1
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            self._maybe_spawn_worker()
+
+    def _maybe_spawn_worker(self) -> None:
+        with self._pool_lock:
+            if self._workers >= self.max_workers:
+                return
+            self._workers += 1
+        threading.Thread(
+            target=self._worker_loop,
+            name=f"fastserve-worker-{self._workers}",
+            daemon=True,
+        ).start()
+
+    def _worker_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._conns.get(timeout=1.0)
+            except queue.Empty:
+                continue  # poll the shutdown flag; workers live with the server
+            self._serve_connection(conn)
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -96,16 +161,55 @@ class FastHTTPServer:
             self._sock.close()
         except OSError:
             pass
+        # accepted-but-unserved connections must not leak past the
+        # server's lifetime: close them instead of leaving clients
+        # hanging on sockets no worker will ever pick up
+        while True:
+            try:
+                conn = self._conns.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     server_close = shutdown  # stock servers expose both
 
     # -- connection loop ---------------------------------------------------
+    def _await_request_line(self, conn, rfile):
+        """Block for the next request's first line in short slices.
+
+        The between-requests idle wait is where a keep-alive connection
+        can pin a worker: with the whole pool pinned by idle sessions, a
+        newly accepted connection would otherwise starve in the hand-off
+        queue for the full 300 s keep-alive allowance. Waiting in 5 s
+        slices lets the worker yield (returning None closes this
+        connection) as soon as another connection is queued, while a
+        sole idle client still gets the full allowance. A timeout slice
+        that fires with zero bytes buffered is safe; a client that
+        stalls >5 s MID-line risks its connection (buffered-reader state
+        after a timeout is undefined) — that trade replaces silent
+        starvation of everyone else."""
+        deadline = time.monotonic() + 300.0
+        while not self._shutdown:
+            conn.settimeout(5.0)
+            try:
+                line = rfile.readline(_MAX_LINE + 1)
+            except TimeoutError:
+                if not self._conns.empty() or time.monotonic() > deadline:
+                    return None  # yield the worker / reap the idler
+                continue
+            conn.settimeout(30.0)  # per-read budget for the rest
+            return line
+        return None
+
     def _serve_connection(self, conn: socket.socket) -> None:
-        conn.settimeout(300.0)  # reap half-dead keep-alive clients
         rfile = conn.makefile("rb", -1)
         try:
             while not self._shutdown:
-                if not self._handle_one(conn, rfile):
+                line = self._await_request_line(conn, rfile)
+                if line is None or not self._handle_one(conn, rfile, line):
                     break
         except (OSError, ValueError):
             pass  # client went away mid-request; nothing to answer
@@ -119,9 +223,10 @@ class FastHTTPServer:
                     pass
                 conn.close()
 
-    def _handle_one(self, conn, rfile) -> bool:
-        """Serve one request; returns False when the connection is done."""
-        line = rfile.readline(_MAX_LINE + 1)
+    def _handle_one(self, conn, rfile, line: bytes) -> bool:
+        """Serve one request (whose first line the worker already read in
+        ``_await_request_line``); returns False when the connection is
+        done."""
         if not line:
             return False  # client closed cleanly between requests
         if line in (b"\r\n", b"\n"):
@@ -176,20 +281,34 @@ class FastHTTPServer:
             return False
 
         status, payload, close_after = self._route(
-            method, path.decode("latin-1"), body, t0
+            method,
+            path.decode("latin-1"),
+            body,
+            t0,
+            deadline_ms=http_api._parse_deadline_ms(
+                headers.get(b"x-deadline-ms")
+            ),
         )
         self._reply(conn, status, payload, close=close or close_after)
         return not (close or close_after)
 
     # -- routing -----------------------------------------------------------
-    def _route(self, method: bytes, path: str, body: bytes, t0: float):
+    def _route(
+        self, method: bytes, path: str, body: bytes, t0: float,
+        deadline_ms=None,
+    ):
         """Returns (status, payload, close_after). Bodies come from the
         shared route cores — byte-identical to the stock transport."""
         node = self.p2p_node
         if method == b"POST":
             if path == "/solve":
-                status, payload, error = http_api.solve_route(node, body)
-                self._record("/solve", t0, error=error)
+                status, payload, error = http_api.solve_route(
+                    node, body, deadline_ms=deadline_ms
+                )
+                shed = status == 429
+                self._record(
+                    "/solve", t0, error=error and not shed, shed=shed
+                )
                 return status, payload, False
             if path == "/solve_batch" and self.expose_batch:
                 status, payload, error = http_api.solve_batch_route(
@@ -214,15 +333,22 @@ class FastHTTPServer:
                 return 200, http_api.metrics_payload(node), False
         return 404, {"error": "Invalid endpoint"}, False
 
-    def _record(self, route: str, t0: float, error: bool = False) -> None:
+    def _record(
+        self, route: str, t0: float, error: bool = False, shed: bool = False
+    ) -> None:
         m = getattr(self.p2p_node, "metrics", None)
         if m is not None:
-            m.record(route, time.perf_counter() - t0, error=error)
+            m.record(route, time.perf_counter() - t0, error=error, shed=shed)
 
     # -- response ----------------------------------------------------------
     @staticmethod
     def _reply(conn, status: int, payload, *, close: bool) -> None:
         body = json.dumps(payload).encode()
+        extra = b"Connection: close\r\n" if close else b""
+        if status == 429:
+            retry = http_api.retry_after_header(payload)
+            if retry is not None:
+                extra = b"Retry-After: %s\r\n%s" % (retry.encode(), extra)
         head = (
             b"HTTP/1.1 %d %s\r\n"
             b"Content-type: application/json\r\n"
@@ -232,7 +358,7 @@ class FastHTTPServer:
                 status,
                 _REASONS.get(status, b"Unknown"),
                 len(body),
-                b"Connection: close\r\n" if close else b"",
+                extra,
             )
         )
         conn.sendall(head + body)

@@ -55,8 +55,88 @@ def _board_error(sudoku, size: int) -> str | None:
 # matter which transport carried the request.
 
 
-def solve_route(p2p_node, body: bytes):
-    """POST /solve: the reference's solve surface (node.py:661-690)."""
+def _parse_deadline_ms(raw):
+    """``X-Deadline-Ms`` header → float ms (relative latency budget), or
+    None when absent/garbage. Garbage is treated as no header rather than
+    a 400: the header is advisory and must never break a client that
+    would have succeeded without it. A non-positive value is meaningful —
+    it is already expired at arrival and sheds immediately
+    (serving/admission.py)."""
+    if raw is None:
+        return None
+    if isinstance(raw, bytes):
+        raw = raw.decode("latin-1", "replace")
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+def _shed_payload(error: str, retry_after_s) -> dict:
+    """The 429 body shape (admission shed / expired deadline). Carries the
+    retry hint in ms so transports can derive the Retry-After header
+    (integer seconds) from the payload without a side channel."""
+    return {
+        "error": error,
+        "retry_after_ms": round(max(0.0, retry_after_s or 0.0) * 1e3, 1),
+    }
+
+
+def retry_after_header(payload) -> str | None:
+    """Retry-After header value (integer seconds, floor 1) for a 429
+    payload built by ``_shed_payload``; None for anything else."""
+    if isinstance(payload, dict) and "retry_after_ms" in payload:
+        return str(max(1, -(-int(payload["retry_after_ms"]) // 1000)))
+    return None
+
+
+def solve_route(p2p_node, body: bytes, deadline_ms=None):
+    """POST /solve: the reference's solve surface (node.py:661-690).
+
+    ``deadline_ms`` is the request's relative latency budget (the
+    ``X-Deadline-Ms`` header, parsed by the transport). With an admission
+    controller attached to the node (serving/admission.py; off by
+    default), overload answers ``429`` here — shed at arrival when the
+    projected queue wait already exceeds the budget or the pending
+    capacity is full, or after the fact when the request expired waiting
+    in the coalescer queue. Without one, behavior is byte-identical to
+    the pre-admission stack (the header is ignored).
+    """
+    adm = getattr(p2p_node, "admission", None)
+    if adm is None:
+        return _solve_core(p2p_node, body, None)
+    decision = adm.try_admit(deadline_ms)
+    if not decision.admitted:
+        logger.debug("shed /solve at arrival (%s)", decision.reason)
+        return (
+            429,
+            _shed_payload("Overloaded", decision.retry_after_s),
+            True,
+        )
+    from ..serving.admission import DeadlineExceeded
+
+    expired = False
+    outcome = {"served": False}
+    try:
+        return _solve_core(p2p_node, body, decision.deadline_s, outcome)
+    except DeadlineExceeded:
+        # admitted in time, overtaken by load: dropped at batch formation
+        # (parallel/coalescer.py) — the device never ran it
+        expired = True
+        return (
+            429,
+            _shed_payload("Deadline exceeded", adm.retry_hint_s()),
+            True,
+        )
+    finally:
+        # served=False (a body rejected before the engine ran) must not
+        # feed the completion-rate estimator: a malformed-body flood
+        # would otherwise read as huge capacity and disable the
+        # projected-wait shed exactly when real traffic needs it
+        adm.release(expired=expired, served=outcome["served"])
+
+
+def _solve_core(p2p_node, body: bytes, deadline_s, outcome=None):
     # debug, not info: two formatted log records per request is measurable
     # GIL time under a 64-client closed loop (the reference logs every
     # request at INFO, but its serving path was never multi-tenant);
@@ -74,7 +154,9 @@ def solve_route(p2p_node, body: bytes):
     if reason is not None:
         logger.info("rejected /solve body: %s", reason)
         return 400, {"error": "Invalid request"}, True
-    solution = p2p_node.peer_sudoku_solve(sudoku)
+    if outcome is not None:
+        outcome["served"] = True  # past validation: the engine runs now
+    solution = p2p_node.peer_sudoku_solve(sudoku, deadline_s=deadline_s)
     logger.debug("execution time: %s", time.time() - t_in)
     if solution:
         return 200, solution, False
@@ -148,6 +230,14 @@ def metrics_payload(p2p_node):
     )
     if m_health is not None:
         body["membership"] = m_health()
+    adm = getattr(p2p_node, "admission", None)
+    if adm is not None:
+        # the overload control plane's view: shed/expired counters, queue
+        # depth, EWMA arrival/completion rates, projected wait
+        # (serving/admission.py); the coalescer's expired counter and —
+        # in adaptive mode — the current max-wait ride under
+        # "engine"/"coalescer" above
+        body["admission"] = adm.snapshot()
     return body
 
 
@@ -178,6 +268,10 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if status == 429:
+            retry = retry_after_header(content)
+            if retry is not None:
+                self.send_header("Retry-After", retry)
         if self.close_connection:
             # a handler that bailed without consuming the request body sets
             # close_connection (leftover bytes would desync keep-alive
@@ -187,10 +281,12 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _record(self, route: str, t0: float, error: bool = False) -> None:
+    def _record(
+        self, route: str, t0: float, error: bool = False, shed: bool = False
+    ) -> None:
         m = getattr(self.p2p_node, "metrics", None)
         if m is not None:
-            m.record(route, time.perf_counter() - t0, error=error)
+            m.record(route, time.perf_counter() - t0, error=error, shed=shed)
 
     def _read_body(self, route: str, t0: float, max_bytes=None):
         """Read the request body with keep-alive-safe framing. Returns the
@@ -222,10 +318,16 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             post_data = self._read_body("/solve", t0)
             if post_data is None:
                 return
-            status, payload, error = solve_route(self.p2p_node, post_data)
+            status, payload, error = solve_route(
+                self.p2p_node, post_data,
+                deadline_ms=_parse_deadline_ms(
+                    self.headers.get("X-Deadline-Ms")
+                ),
+            )
             # record before replying: a client may poll /metrics the
             # instant its response arrives
-            self._record("/solve", t0, error=error)
+            shed = status == 429
+            self._record("/solve", t0, error=error and not shed, shed=shed)
             self._send_response(payload, status)
         elif self.path == "/solve_batch" and self.expose_batch:
             post_data = self._read_body(
@@ -270,6 +372,7 @@ def make_http_server(
     expose_batch: bool = False,
     expose_serving: bool = False,
     legacy_transport: bool = False,
+    max_workers: int = 128,
 ):
     """Default: the lean keep-alive transport (net/fastserve.py) — a deep
     accept queue and ~an order of magnitude less pure-Python per request
@@ -278,7 +381,11 @@ def make_http_server(
     stock http.server speaking HTTP/1.0 (a connection per request) on the
     stock 5-deep accept queue — for A/B measurement (bench.py --mode
     concurrent drives both under identical load). Both return the same
-    lifecycle surface: serve_forever() / shutdown() / server_address."""
+    lifecycle surface: serve_forever() / shutdown() / server_address.
+    ``max_workers`` bounds the lean transport's connection-worker pool
+    (net/fastserve.py; the legacy transport keeps the seed's unbounded
+    thread-per-connection behavior — it exists to BE the seed, bit for
+    bit)."""
     if legacy_transport:
         handler = type(
             "BoundHandler",
@@ -302,6 +409,7 @@ def make_http_server(
             expose_metrics=expose_metrics,
             expose_batch=expose_batch,
             expose_serving=expose_serving,
+            max_workers=max_workers,
         )
     logger.info("HTTP server on %s:%s", host, http_port)
     return httpd

@@ -61,6 +61,7 @@ class P2PNode:
         fault_injector=None,
         tombstone_ttl_s: Optional[float] = None,
         serialize_solves: bool = False,
+        admission=None,
     ):
         self.host = host
         self.port = port
@@ -138,6 +139,11 @@ class P2PNode:
         # request-latency recorder fed by the HTTP layer (utils/profiling.py);
         # optional so bare nodes pay nothing
         self.metrics = metrics
+        # overload control plane (serving/admission.py): when set, the
+        # HTTP route core sheds /solve arrivals past the pending budget or
+        # whose deadline cannot be met (net/http_api.solve_route); None —
+        # the default — keeps the accept-everything PR 1 behavior
+        self.admission = admission
         # chaos-testing hook (utils/faults.FaultInjector): when set, every
         # outbound datagram is planned through it — dropped, delayed, or
         # duplicated deterministically. The fault tooling the reference
@@ -380,13 +386,18 @@ class P2PNode:
         # re-declares the death within failure_timeout.
         if self.failure_timeout and source is not None:
             try:
-                # port-only match: a "localhost"-bound node's datagrams
-                # arrive from "127.0.0.1", so host comparison would
-                # mislabel its goodbye as a rumor (the same alias problem
-                # as heartbeat keying, __init__). A cross-host port
-                # collision merely HONORS the message — the pre-rejection
-                # behavior — never rejects a goodbye.
-                self_announced = source[1] == wire.parse_address(address)[1]
+                # (host, port) match with loopback/alias normalization
+                # (wire.canonical_host): a "localhost"-bound node's
+                # datagrams arrive from "127.0.0.1" and must still read as
+                # its own goodbye. The former port-only comparison
+                # (ADVICE r5 medium / ROADMAP item 4) misclassified a
+                # THIRD-PARTY deletion relay from a same-port peer on
+                # another host as a goodbye, bypassing rumor rejection —
+                # same-port fleets are the normal multi-host deployment
+                # shape (every host runs the same CLI with the same -s).
+                self_announced = wire.same_endpoint(
+                    (source[0], source[1]), wire.parse_address(address)
+                )
             except (ValueError, TypeError, IndexError):
                 self_announced = False
             if not self_announced:
@@ -490,9 +501,16 @@ class P2PNode:
         self.broadcast_stats()  # same trigger as reference node.py:406
 
     # -- master side -------------------------------------------------------
-    def peer_sudoku_solve(self, sudoku) -> Optional[list]:
+    def peer_sudoku_solve(self, sudoku, deadline_s=None) -> Optional[list]:
         """Solve a request board, farming cells to peers when there are any
         (reference node.py:534-557). Returns the solved grid or None.
+
+        ``deadline_s`` (absolute monotonic, from the admission layer) rides
+        the engine path into the coalescer, where an expired request is
+        dropped at batch formation (DeadlineExceeded propagates to the
+        HTTP layer's 429). The peer task farm ignores it: farmed cells are
+        multi-second round-trips by construction and admission's
+        projected-wait shed is the protection that applies there.
 
         With the frontier engine enabled the mesh race *is* the distributed
         path — it replaces the per-cell peer farm for the request (P2P peers
@@ -510,9 +528,23 @@ class P2PNode:
         if not peers or self.engine.frontier_enabled:
             if self.serialize_solves:
                 with self._solve_lock:
+                    if deadline_s is not None and (
+                        time.monotonic() > deadline_s
+                    ):
+                        # the seed-fidelity path queues ON the lock: a
+                        # request whose deadline passed while it waited
+                        # there is the same expired-in-queue case the
+                        # coalescer drops at batch formation
+                        from ..serving.admission import DeadlineExceeded
+
+                        raise DeadlineExceeded(
+                            "deadline expired waiting for the solve lock"
+                        )
                     solution, _ = self.engine.solve_one(sudoku)
             else:
-                solution, _ = self.engine.solve_one_async(sudoku).result()
+                solution, _ = self.engine.solve_one_async(
+                    sudoku, deadline_s=deadline_s
+                ).result()
             if solution is not None:
                 with self._state_lock:
                     self._solved_count += 1

@@ -60,6 +60,59 @@ def valid_address(address) -> bool:
     return bool(host) and 0 <= port <= 65535
 
 
+_LOOPBACK_NAMES = {"localhost", "localhost.localdomain", "ip6-localhost"}
+
+
+def canonical_host(host: str) -> str:
+    """Normalize a host for identity comparison (goodbye-vs-rumor
+    discrimination, net/node.py): every loopback alias — "localhost", any
+    127.0.0.0/8 literal, "::1" — maps to "127.0.0.1", so a node bound to
+    "localhost" whose datagrams arrive from "127.0.0.1" (or 127.0.1.1,
+    Debian's /etc/hosts quirk) compares equal to itself. Non-loopback
+    hosts are case-folded only: resolving arbitrary names here would put
+    a blocking DNS lookup on the UDP receive path."""
+    h = host.strip().lower()
+    if h in _LOOPBACK_NAMES or h == "::1":
+        return "127.0.0.1"
+    if h.startswith("127."):
+        parts = h.split(".")
+        if len(parts) == 4 and all(p.isascii() and p.isdigit() for p in parts):
+            return "127.0.0.1"
+    return h
+
+
+def is_ip_literal(host: str) -> bool:
+    """A dotted-quad IPv4 or bracketless IPv6 literal (something a UDP
+    source address could ever equal byte-for-byte)."""
+    if ":" in host:
+        return True  # IPv6 literal shape; hostnames can't contain ':'
+    parts = host.split(".")
+    return len(parts) == 4 and all(
+        p.isascii() and p.isdigit() and int(p) <= 255 for p in parts
+    )
+
+
+def same_endpoint(source: Tuple[str, int], announced: Tuple[str, int]) -> bool:
+    """Does a datagram's UDP ``source`` plausibly belong to the
+    ``announced`` "host:port" identity? The goodbye-vs-rumor test
+    (net/node.py).
+
+    When the announced host is an IP literal (after loopback/alias
+    normalization — the normal deployment shape, and the only one where
+    same-port multi-host rumor confusion can arise), the comparison is
+    strict (host, port). When a node announced itself by HOSTNAME, its
+    datagrams arrive from an IP we cannot compare without putting a DNS
+    lookup on the UDP receive path — fall back to the port-only
+    heuristic (the pre-PR-2 behavior) rather than misread every such
+    node's own goodbye as a rumor."""
+    if source[1] != announced[1]:
+        return False
+    ann = canonical_host(announced[0])
+    if not is_ip_literal(ann):
+        return True  # hostname identity: port match is the best we have
+    return canonical_host(source[0]) == ann
+
+
 def encode_msg(msg: Msg) -> bytes:
     return json.dumps(msg).encode()
 

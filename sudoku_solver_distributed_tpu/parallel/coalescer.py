@@ -55,6 +55,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..serving.admission import DeadlineExceeded
 from ..utils.profiling import annotate
 
 logger = logging.getLogger(__name__)
@@ -63,12 +64,16 @@ _SENTINEL = object()
 
 
 class _Request:
-    __slots__ = ("board", "future", "enqueued")
+    __slots__ = ("board", "future", "enqueued", "deadline")
 
-    def __init__(self, board: np.ndarray):
+    def __init__(self, board: np.ndarray, deadline: Optional[float] = None):
         self.board = board
         self.future: Future = Future()
         self.enqueued = time.monotonic()
+        # absolute monotonic deadline (serving/admission.py) or None; an
+        # expired request is dropped at batch-formation time so the device
+        # never solves a board nobody is waiting for
+        self.deadline = deadline
 
 
 class BatchCoalescer:
@@ -100,6 +105,10 @@ class BatchCoalescer:
         engine.SolverEngine(coalesce_max_batch=...) for measurements.
       max_pending: queue bound; ``submit`` blocks past it (backpressure —
         the HTTP thread pool is the natural concurrency cap above us).
+      wait_policy: optional serving.load.AdaptiveWaitPolicy — when set,
+        the three wait budgets above become CAPS and each batch formation
+        asks the policy for the current values (near-zero when idle,
+        stretched toward the caps under load; ROADMAP open item 1).
     """
 
     def __init__(
@@ -112,6 +121,7 @@ class BatchCoalescer:
         inflight_depth: int = 2,
         max_batch: Optional[int] = None,
         max_pending: int = 8192,
+        wait_policy=None,
     ):
         if inflight_depth < 1:
             raise ValueError("inflight_depth must be >= 1")
@@ -127,6 +137,7 @@ class BatchCoalescer:
         if burst_wait_s is None:
             burst_wait_s = 10.0 * max_wait_s
         self.burst_wait_s = max(burst_wait_s, max_wait_s)
+        self.wait_policy = wait_policy
         self.max_pending = max_pending
         self._max_batch = min(engine.buckets[-1], max_batch or engine.buckets[-1])
         self._pending: deque = deque()
@@ -150,6 +161,7 @@ class BatchCoalescer:
         self.last_batch_fill = 0
         self.max_batch_fill = 0
         self.max_queue_depth = 0
+        self.expired = 0  # requests dropped at batch formation (deadline)
         self._wait_sum_s = 0.0
         self._wait_max_s = 0.0
 
@@ -190,15 +202,26 @@ class BatchCoalescer:
             self._completer.join(timeout=timeout)
 
     # -- client surface ----------------------------------------------------
-    def submit(self, board: np.ndarray) -> Future:
+    def submit(
+        self, board: np.ndarray, deadline_s: Optional[float] = None
+    ) -> Future:
         """Enqueue one board; the Future resolves to (solution | None, info)
         with the same contract as ``SolverEngine.solve_one``. Raises
         ValueError synchronously on a wrong-shape board — an unvalidated
         board must fail ITS caller, not poison the np.stack of everyone
         coalesced into the same batch (the HTTP layer validates upstream,
-        but solve_one_async is a public library surface)."""
+        but solve_one_async is a public library surface).
+
+        ``deadline_s`` is an absolute ``time.monotonic()`` deadline
+        (serving/admission.py): a request still queued past it is dropped
+        at batch-formation time and its future raises DeadlineExceeded —
+        the device never computes an answer nobody is waiting for. A
+        request whose batch already dispatched is delivered normally (the
+        deadline guards queue wait, not service time already paid)."""
         self.start()
-        req = _Request(np.asarray(board, np.int32))
+        if self.wait_policy is not None:
+            self.wait_policy.on_arrival()
+        req = _Request(np.asarray(board, np.int32), deadline_s)
         size = self._engine.spec.size
         if req.board.shape != (size, size):
             raise ValueError(
@@ -244,10 +267,19 @@ class BatchCoalescer:
                 # two bound the second
                 "quiescence_ms": round(self.quiescence_s * 1e3, 3),
                 "burst_wait_budget_ms": round(self.burst_wait_s * 1e3, 3),
+                "expired": self.expired,
             }
         with self._cond:
             out["queue_depth"] = len(self._pending)
         out["max_queue_depth"] = self.max_queue_depth
+        if self.wait_policy is not None:
+            out["adaptive"] = True
+            out["current_max_wait_ms"] = round(
+                self.wait_policy.current_max_wait_s * 1e3, 3
+            )
+            out["arrival_rate_hz"] = round(
+                self.wait_policy.arrivals.rate(), 3
+            )
         return out
 
     # -- dispatcher side ---------------------------------------------------
@@ -269,39 +301,84 @@ class BatchCoalescer:
             arrivals and is never delayed past ``max_wait_s``.
 
         Both are the continuous-batching payoff under saturation. Drains
-        up to the largest bucket. Returns None when shut down and fully
-        drained."""
-        with self._cond:
-            while not self._pending and not self._shutdown:
-                self._cond.wait()
-            if not self._pending:
-                return None  # shutdown, queue drained
-            deadline = self._pending[0].enqueued + self.max_wait_s
-            burst_cap = self._pending[0].enqueued + self.burst_wait_s
-            while len(self._pending) < self._max_batch and not self._shutdown:
-                now = time.monotonic()
-                if now < deadline:
-                    self._cond.wait(timeout=deadline - now)
-                elif self._inflight.full():
-                    # pipeline full: the completer notifies _cond when it
-                    # frees a slot; the timeout only guards a lost wakeup
-                    self._cond.wait(timeout=0.05)
-                else:
-                    quiet_at = self._last_arrival + self.quiescence_s
-                    if now >= burst_cap or now >= quiet_at:
-                        break
-                    self._cond.wait(timeout=min(quiet_at, burst_cap) - now)
+        up to the largest bucket, dropping requests whose deadline already
+        passed (their futures raise DeadlineExceeded — the device never
+        solves a board nobody is waiting for). Returns None when shut down
+        and fully drained."""
+        while True:
+            with self._cond:
+                while not self._pending and not self._shutdown:
+                    self._cond.wait()
                 if not self._pending:
-                    # spurious wake after another consumer? there is only
-                    # one dispatcher, but guard against an empty drain
-                    if self._shutdown:
-                        return None
-                    deadline = time.monotonic() + self.max_wait_s
-                    burst_cap = time.monotonic() + self.burst_wait_s
-            take = min(len(self._pending), self._max_batch)
-            batch = [self._pending.popleft() for _ in range(take)]
-            self._cond.notify_all()  # free any submit() blocked on the cap
-            return batch
+                    return None  # shutdown, queue drained
+                # fixed budgets, or the adaptive policy's current values
+                # (read once per batch — one policy call, not per-wake)
+                if self.wait_policy is not None:
+                    max_wait_s, quiescence_s, burst_wait_s = (
+                        self.wait_policy.budgets(len(self._pending))
+                    )
+                    burst_wait_s = max(burst_wait_s, max_wait_s)
+                else:
+                    max_wait_s = self.max_wait_s
+                    quiescence_s = self.quiescence_s
+                    burst_wait_s = self.burst_wait_s
+                deadline = self._pending[0].enqueued + max_wait_s
+                burst_cap = self._pending[0].enqueued + burst_wait_s
+                while (
+                    len(self._pending) < self._max_batch
+                    and not self._shutdown
+                ):
+                    now = time.monotonic()
+                    if now < deadline:
+                        self._cond.wait(timeout=deadline - now)
+                    elif self._inflight.full():
+                        # pipeline full: the completer notifies _cond when
+                        # it frees a slot; the timeout guards a lost wakeup
+                        self._cond.wait(timeout=0.05)
+                    else:
+                        quiet_at = self._last_arrival + quiescence_s
+                        if now >= burst_cap or now >= quiet_at:
+                            break
+                        self._cond.wait(
+                            timeout=min(quiet_at, burst_cap) - now
+                        )
+                    if not self._pending:
+                        # spurious wake after another consumer? there is
+                        # only one dispatcher, but guard an empty drain
+                        if self._shutdown:
+                            return None
+                        deadline = time.monotonic() + max_wait_s
+                        burst_cap = time.monotonic() + burst_wait_s
+                # drain up to a bucket of LIVE requests; expired ones are
+                # dropped here — after the wait, right before dispatch —
+                # so every board that reaches the device still has a
+                # waiting caller
+                now = time.monotonic()
+                batch: List[_Request] = []
+                dropped: List[_Request] = []
+                while self._pending and len(batch) < self._max_batch:
+                    req = self._pending.popleft()
+                    if req.deadline is not None and now > req.deadline:
+                        dropped.append(req)
+                    else:
+                        batch.append(req)
+                self._cond.notify_all()  # free submit() blocked on the cap
+            if dropped:
+                with self._stats_lock:
+                    self.expired += len(dropped)
+                # resolve outside the condition lock: future callbacks run
+                # inline in set_exception and must not re-enter the queue
+                for r in dropped:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            DeadlineExceeded(
+                                "deadline expired in the coalescer queue"
+                            )
+                        )
+            if batch:
+                return batch
+            # every drained request had expired: go back to waiting (or
+            # drain the remainder on shutdown)
 
     def _dispatcher_loop(self) -> None:
         while True:

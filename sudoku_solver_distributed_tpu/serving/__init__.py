@@ -1,0 +1,35 @@
+"""Overload control plane: the layer between transport and coalescer.
+
+PR 1 made the serving path fast (request coalescing + lean keep-alive
+transport); this package makes it survive being popular. Three pieces:
+
+  * admission.py — ``AdmissionController``: bounded pending budget +
+    per-request deadlines; overload is answered with an honest, cheap
+    ``429 Retry-After`` at the door instead of an arbitrarily late 200,
+    and requests that expire waiting are dropped before the device ever
+    sees them (parallel/coalescer.py batch-formation drop).
+  * load.py — ``EwmaRate`` / ``AdaptiveWaitPolicy``: lock-cheap arrival
+    and completion rate estimation, driving both the admission
+    projection and the adaptive coalescer max-wait (near-zero when idle,
+    stretched toward the cap under load — ROADMAP open item 1).
+  * wiring — net/fastserve.py (bounded worker pool), net/http_api.py
+    (shared 429 route core), net/cli.py (``--admission-capacity``,
+    ``--default-deadline-ms``, ``--adaptive-coalesce``), /metrics
+    (shed/expired counters, rates, current max-wait), and
+    ``bench.py --mode overload`` (the open-loop Poisson proof).
+
+Everything defaults off: a node started without the new flags serves
+byte-identically to the PR 1 stack.
+"""
+
+from .admission import AdmissionController, Decision, DeadlineExceeded
+from .load import AdaptiveWaitPolicy, EwmaRate, WindowRate
+
+__all__ = [
+    "AdmissionController",
+    "Decision",
+    "DeadlineExceeded",
+    "AdaptiveWaitPolicy",
+    "EwmaRate",
+    "WindowRate",
+]

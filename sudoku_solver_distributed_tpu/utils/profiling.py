@@ -43,17 +43,34 @@ class RequestMetrics:
         self._lat: Dict[str, deque] = {}
         self._count: Dict[str, int] = {}
         self._errors: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
 
-    def record(self, route: str, seconds: float, error: bool = False) -> None:
+    def record(
+        self,
+        route: str,
+        seconds: float,
+        error: bool = False,
+        shed: bool = False,
+    ) -> None:
+        """``shed`` marks an admission 429 (serving/admission.py): counted
+        separately from ``errors`` — a shed is the overload control plane
+        WORKING, and lumping it with malformed-body 400s would make the
+        error rate useless as an alarm exactly when traffic is heaviest.
+        Shed replies still land in the latency window (they are real
+        responses the client waited for — microseconds, which is the
+        point)."""
         with self._lock:
             if route not in self._lat:
                 self._lat[route] = deque(maxlen=self._window)
                 self._count[route] = 0
                 self._errors[route] = 0
+                self._shed[route] = 0
             self._lat[route].append(seconds)
             self._count[route] += 1
             if error:
                 self._errors[route] += 1
+            if shed:
+                self._shed[route] += 1
 
     @staticmethod
     def _pct(sorted_vals, q: float) -> float:
@@ -63,7 +80,7 @@ class RequestMetrics:
         return sorted_vals[idx]
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        """{route: {count, errors, p50_ms, p95_ms, p99_ms, max_ms}}."""
+        """{route: {count, errors, shed, p50_ms, p95_ms, p99_ms, max_ms}}."""
         with self._lock:
             out: Dict[str, Dict[str, float]] = {}
             for route, window in self._lat.items():
@@ -71,6 +88,7 @@ class RequestMetrics:
                 out[route] = {
                     "count": self._count[route],
                     "errors": self._errors[route],
+                    "shed": self._shed[route],
                     "p50_ms": round(self._pct(vals, 0.50) * 1e3, 3),
                     "p95_ms": round(self._pct(vals, 0.95) * 1e3, 3),
                     "p99_ms": round(self._pct(vals, 0.99) * 1e3, 3),

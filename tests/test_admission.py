@@ -1,0 +1,461 @@
+"""Overload control plane (ISSUE 2): admission control, per-request
+deadlines, adaptive coalescer max-wait, and the bounded serving worker
+pool — with all knobs at defaults the serving surface stays byte-identical
+(the existing test_net_node.py suite is that regression net)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import generate_batch
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.parallel.coalescer import BatchCoalescer
+from sudoku_solver_distributed_tpu.serving import (
+    AdmissionController,
+    AdaptiveWaitPolicy,
+    DeadlineExceeded,
+    EwmaRate,
+    WindowRate,
+)
+from sudoku_solver_distributed_tpu.utils.profiling import RequestMetrics
+
+
+def free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1, 8))
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def boards():
+    return generate_batch(16, 40, seed=11)
+
+
+# -- load estimation --------------------------------------------------------
+
+def test_ewma_rate_tracks_and_decays():
+    r = EwmaRate(tau_s=1.0)
+    assert r.rate(0.0) == 0.0
+    t = 0.0
+    for _ in range(50):
+        t += 0.01  # steady 100 Hz
+        r.observe(t)
+    assert 80.0 <= r.rate(t) <= 120.0
+    # a stopped stream must read as a falling rate, not freeze at 100
+    assert r.rate(t + 1.0) < 2.0
+
+
+def test_window_rate_is_burst_correct_and_freezes():
+    """A gap EWMA under-reads a bursty stream by the batch width (the
+    live failure that shed a working node to nothing — load.WindowRate
+    docstring); the windowed counter must read bursts exactly, and the
+    frozen read must survive a completions pause instead of decaying
+    into a shed-storm feedback loop."""
+    w = WindowRate(window_s=2.0)
+    t = 0.0
+    # 225/s arriving as bursts of 8 every ~35.5 ms (the coalesced batch
+    # fan-out shape)
+    n = 0
+    while t < 4.0:
+        for _ in range(8):
+            w.observe(t)
+            n += 1
+        t += 8 / 225.0
+    assert w.rate(t) == pytest.approx(225.0, rel=0.15)
+    # stream pauses (e.g. everything is being shed): plain read decays,
+    # frozen read keeps the last busy-period capacity estimate
+    assert w.rate(t + 10.0) == 0.0
+    assert w.rate(t + 10.0, frozen=True) == pytest.approx(225.0, rel=0.2)
+
+
+def test_adaptive_wait_monotone_in_load():
+    """Satellite: adaptive max-wait monotonicity under synthetic load —
+    more arrivals can only stretch the wait toward the cap, never
+    shrink or oscillate it."""
+    p = AdaptiveWaitPolicy(max_wait_s=0.002, quiescence_s=0.001)
+    rates = [0.0, 10.0, 50.0, 200.0, 500.0, 2000.0, 1e6]
+    factors = [p.load_factor(r) for r in rates]
+    assert factors == sorted(factors)
+    assert factors[0] == 0.0          # idle: no wait at all
+    assert factors[-1] == 1.0         # saturated: the full budget
+    # budgets() scales all three knobs by the same factor and records the
+    # current max-wait for /metrics; budgets() reads the wall clock, so
+    # the synthetic 1 kHz stream must end AT now for the factor to be 1.0
+    t = time.monotonic() - 0.1
+    for _ in range(100):
+        t += 0.001  # 1 kHz -> factor 1.0
+        p.arrivals.observe(t)
+    mw, q, bw = p.budgets()
+    assert mw == pytest.approx(0.002, rel=0.05)
+    assert q == pytest.approx(0.001, rel=0.05)
+    assert bw == pytest.approx(0.020, rel=0.05)
+    assert p.current_max_wait_s == mw
+
+
+# -- admission controller ---------------------------------------------------
+
+def test_admission_capacity_shed_and_release():
+    a = AdmissionController(capacity=2)
+    d1, d2 = a.try_admit(), a.try_admit()
+    assert d1.admitted and d2.admitted
+    d3 = a.try_admit()
+    assert not d3.admitted and d3.reason == "capacity"
+    assert d3.retry_after_s >= 1.0
+    a.release()
+    assert a.try_admit().admitted  # slot freed
+    snap = a.snapshot()
+    assert snap["shed_capacity"] == 1 and snap["admitted"] == 3
+    assert snap["pending"] == 2
+
+
+def test_admission_deadline_shed_at_arrival():
+    """A request whose budget is already spent (non-positive header) or
+    cannot be met by the projected queue wait sheds at arrival."""
+    a = AdmissionController(capacity=0, default_deadline_ms=100)
+    d = a.try_admit(-1.0)
+    assert not d.admitted and d.reason == "deadline"
+    # build a measured completion rate of ~10/s (stamps anchored in the
+    # PAST so the interleaved try_admit reads, which use the real clock,
+    # never see future events), then a backlog of 5 pending ->
+    # projected wait 500 ms > the 100 ms default budget
+    t = time.monotonic() - 2.0
+    for k in range(20):
+        a.try_admit(10_000.0)
+        a._completions.observe(t + k * 0.1)
+    assert a._completions.rate(t + 2.0) == pytest.approx(10.0, rel=0.2)
+    a.pending = 5
+    d = a.try_admit()
+    assert not d.admitted and d.reason == "deadline"
+    # an explicit header generous enough for the projection is admitted
+    assert a.try_admit(10_000.0).admitted
+
+
+def test_admission_expired_releases_do_not_inflate_capacity():
+    a = AdmissionController(capacity=8)
+    for _ in range(6):
+        assert a.try_admit().admitted
+        a.release(expired=True)
+    snap = a.snapshot()
+    assert snap["expired"] == 6 and snap["completed"] == 0
+    # cheap expired drops contribute NOTHING to the completion rate the
+    # projection divides by
+    assert snap["completion_rate_hz"] == 0.0
+
+
+def test_admission_default_deadline_attached_to_admitted_requests():
+    a = AdmissionController(default_deadline_ms=250)
+    d = a.try_admit()
+    assert d.admitted
+    assert d.deadline_s == pytest.approx(time.monotonic() + 0.25, abs=0.05)
+    # no default, no header -> no deadline
+    assert AdmissionController().try_admit().deadline_s is None
+
+
+# -- coalescer deadline edge cases ------------------------------------------
+
+def test_coalescer_drops_already_expired_at_batch_formation(engine, boards):
+    """Already-expired at arrival: the future resolves DeadlineExceeded
+    and no device call runs for it."""
+    calls = []
+    real = engine._dispatch_padded
+    co = BatchCoalescer(engine, max_wait_s=0.02)
+    engine_dispatch = engine._dispatch_padded
+
+    def spy(b):
+        calls.append(b.shape[0])
+        return engine_dispatch(b)
+
+    engine._dispatch_padded = spy
+    try:
+        fut = co.submit(boards[0], time.monotonic() - 0.1)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        assert co.stats()["expired"] == 1
+        assert calls == []  # the device never saw it
+        # the coalescer stays healthy for live traffic afterwards
+        solution, info = co.submit(boards[1]).result(timeout=60)
+        assert solution is not None, info
+    finally:
+        engine._dispatch_padded = real
+        co.close()
+
+
+def test_coalescer_drops_request_that_expires_mid_queue(engine, boards):
+    """Expires mid-queue: admitted with budget, overtaken while waiting
+    for co-riders — dropped at batch formation, not computed late."""
+    co = BatchCoalescer(engine, max_wait_s=0.25)  # long co-rider wait
+    try:
+        fut = co.submit(boards[0], time.monotonic() + 0.05)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        assert co.stats()["expired"] == 1
+    finally:
+        co.close()
+
+
+def test_coalescer_delivers_request_that_expires_mid_flight(engine, boards):
+    """Expires mid-flight: the batch dispatched before the deadline, so
+    the device time is already paid — the result is delivered, never
+    thrown away (the deadline guards queue wait, not service time)."""
+    real = engine._finalize_padded
+
+    def slow_finalize(*handle):
+        time.sleep(0.2)
+        return real(*handle)
+
+    engine._finalize_padded = slow_finalize
+    co = BatchCoalescer(engine, max_wait_s=0.0)  # dispatch immediately
+    try:
+        fut = co.submit(boards[0], time.monotonic() + 0.1)
+        solution, info = fut.result(timeout=60)  # 0.2 s finalize > 0.1 s budget
+        assert solution is not None, info
+        assert co.stats()["expired"] == 0
+    finally:
+        engine._finalize_padded = real
+        co.close()
+
+
+def test_adaptive_lone_request_dispatch_wait_beats_fixed_budget(boards):
+    """ISSUE 2 acceptance: adaptive mode demonstrably reduces a lone
+    request's dispatch wait vs the fixed 2 ms budget — an idle stream
+    should not pay the co-rider wait at all."""
+    waits = {}
+    for adaptive in (False, True):
+        eng = SolverEngine(buckets=(1, 8), coalesce_adaptive=adaptive)
+        eng.warmup()
+        try:
+            for i in range(8):
+                sol, _ = eng.solve_one(boards[i % len(boards)].tolist())
+                assert sol is not None
+                time.sleep(0.05)  # idle spacing: no co-riders in sight
+            waits[adaptive] = eng.coalescer.stats()["avg_wait_ms"]
+        finally:
+            eng.close()
+    # fixed mode waits out the full 2 ms budget for co-riders that never
+    # come; adaptive mode sees a ~20 Hz stream and waits a few percent of
+    # it (generous CI ceilings on both sides of the gap)
+    assert waits[False] >= 1.5, waits
+    assert waits[True] < 1.0, waits
+    assert waits[True] < waits[False] / 2, waits
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+def _post(port, body_obj, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/solve",
+        data=json.dumps(body_obj).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+@pytest.mark.parametrize("legacy", [False, True])
+def test_http_shed_response_shape(engine, legacy):
+    """Satellite: the shed path answers 429 with the documented JSON body
+    and a Retry-After header — on BOTH transports (shared route core)."""
+    adm = AdmissionController(capacity=1, default_deadline_ms=500)
+    node = P2PNode(
+        "127.0.0.1", free_port(), engine=engine,
+        admission=adm, metrics=RequestMetrics(),
+    )
+    httpd = make_http_server(
+        node, "127.0.0.1", free_port(), legacy_transport=legacy,
+        expose_metrics=True,
+    )
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        board = [[0] * 9 for _ in range(9)]
+        # a healthy request passes admission untouched
+        with _post(port, {"sudoku": board}) as r:
+            assert r.status == 200
+        # X-Deadline-Ms <= 0 is already expired at arrival -> 429
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, {"sudoku": board}, {"X-Deadline-Ms": "0"})
+        assert e.value.code == 429
+        retry = e.value.headers.get("Retry-After")
+        assert retry is not None and int(retry) >= 1
+        payload = json.loads(e.value.read())
+        assert payload["error"] == "Overloaded"
+        assert payload["retry_after_ms"] >= 0
+        # capacity shed: fill the only slot, next arrival bounces
+        adm.pending = adm.capacity
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, {"sudoku": board})
+            assert e.value.code == 429
+        finally:
+            adm.pending = 0
+        # /metrics: shed counted apart from errors, admission block live
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            m = json.loads(r.read())
+        assert m["/solve"]["shed"] == 2
+        assert m["/solve"]["errors"] == 0
+        assert m["admission"]["shed_deadline"] == 1
+        assert m["admission"]["shed_capacity"] == 1
+        assert m["admission"]["completed"] == 1
+        assert "arrival_rate_hz" in m["admission"]
+        assert "projected_wait_ms" in m["admission"]
+    finally:
+        httpd.shutdown()
+
+
+def test_http_deadline_ignored_without_admission(engine):
+    """Defaults-off contract: without an AdmissionController the header
+    changes nothing — no 429 surface exists."""
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    httpd = make_http_server(node, "127.0.0.1", free_port())
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with _post(
+            port, {"sudoku": [[0] * 9 for _ in range(9)]},
+            {"X-Deadline-Ms": "0"},
+        ) as r:
+            assert r.status == 200
+    finally:
+        httpd.shutdown()
+
+
+def test_http_garbage_deadline_header_is_ignored(engine):
+    """The header is advisory: garbage must never break a request that
+    would have succeeded without it."""
+    adm = AdmissionController(capacity=4)
+    node = P2PNode("127.0.0.1", free_port(), engine=engine, admission=adm)
+    httpd = make_http_server(node, "127.0.0.1", free_port())
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with _post(
+            port, {"sudoku": [[0] * 9 for _ in range(9)]},
+            {"X-Deadline-Ms": "soon-ish"},
+        ) as r:
+            assert r.status == 200
+    finally:
+        httpd.shutdown()
+
+
+def test_rejected_bodies_do_not_feed_the_capacity_estimate(engine):
+    """code-review PR 2: a malformed-body flood finishes without engine
+    service and must be excluded from the completion rate — counting
+    those cheap 400s as completions would read as huge capacity and
+    disable the projected-wait shed exactly when real traffic needs it."""
+    adm = AdmissionController(capacity=8)
+    node = P2PNode("127.0.0.1", free_port(), engine=engine, admission=adm)
+    httpd = make_http_server(node, "127.0.0.1", free_port())
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        for _ in range(5):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, {"sudoku": "not-a-grid"})
+            assert e.value.code == 400
+        snap = adm.snapshot()
+        assert snap["rejected"] == 5
+        assert snap["completed"] == 0
+        assert snap["completion_rate_hz"] == 0.0
+        assert snap["pending"] == 0  # still released
+    finally:
+        httpd.shutdown()
+
+
+def test_fastserve_saturated_pool_yields_to_queued_connections(engine):
+    """code-review PR 2: with every worker pinned by an idle keep-alive
+    session, a newly accepted connection must be served within the
+    saturation idle allowance (~5 s), not starved for the full 300 s
+    keep-alive timeout."""
+    from sudoku_solver_distributed_tpu.net.fastserve import FastHTTPServer
+
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    httpd = FastHTTPServer(node, "127.0.0.1", 0, max_workers=1)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    body = json.dumps({"sudoku": [[0] * 9 for _ in range(9)]}).encode()
+    try:
+        # pin the only worker with an idle keep-alive session
+        import http.client
+
+        pinned = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        pinned.request(
+            "POST", "/solve", body, {"Content-Type": "application/json"}
+        )
+        assert pinned.getresponse().read()  # served; conn stays open+idle
+        # a second connection must get the worker once the pinned one's
+        # saturation idle allowance expires
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/solve",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+        assert time.monotonic() - t0 < 15.0
+        pinned.close()
+    finally:
+        httpd.shutdown()
+
+
+def test_fastserve_worker_pool_is_bounded(engine):
+    """Satellite: accept-side concurrency is a bounded pool even with
+    admission off — serving many connections over time spawns at most
+    ``max_workers`` threads, and queued connections are served as
+    earlier ones close."""
+    from sudoku_solver_distributed_tpu.net.fastserve import FastHTTPServer
+
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    httpd = FastHTTPServer(node, "127.0.0.1", 0, max_workers=2)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    body = json.dumps({"sudoku": [[0] * 9 for _ in range(9)]}).encode()
+    try:
+        # 6 concurrent connection-per-request clients through 2 workers:
+        # all must be answered (the queue hands conns to freed workers)
+        results = []
+        lock = threading.Lock()
+
+        def client():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/solve",
+                data=body,
+                headers={
+                    "Content-Type": "application/json",
+                    "Connection": "close",
+                },
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = r.status
+            with lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == [200] * 6
+        assert httpd._workers <= 2
+        assert httpd.conns_refused == 0
+    finally:
+        httpd.shutdown()

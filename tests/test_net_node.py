@@ -519,6 +519,45 @@ def test_http_solve_batch_opt_in(engine):
         c.stop()
 
 
+def test_goodbye_vs_rumor_same_port_multi_host(engine):
+    """ADVICE r5 medium / ROADMAP item 4: goodbye-vs-rumor discrimination
+    must compare (host, port) with alias normalization, not port only.
+    Same-port fleets are the normal multi-host shape (every host runs the
+    same CLI with the same -s): a third-party deletion relay from another
+    host's same-port node must be treated as a RUMOR (rejected while the
+    subject was heard recently), while a genuine goodbye — including one
+    whose source host is a loopback alias of the bound name — prunes
+    immediately."""
+    from sudoku_solver_distributed_tpu.net import wire
+
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    victim = "10.0.0.1:7000"
+    relay_same_port = ("10.0.0.2", 7000)  # another host, same -s port
+
+    node.membership.on_connect(victim)
+    node._last_seen[victim] = time.monotonic()  # heard moments ago
+    node.handle_message(
+        wire.disconnect_msg(victim), source=relay_same_port
+    )
+    # rumor about a recently-heard peer: rejected (the pre-fix port-only
+    # comparison misread this relay as the victim's own goodbye)
+    assert victim in node.membership.neighbors()
+
+    # the victim's own goodbye (source == its (host, port)) prunes at once
+    node.handle_message(wire.disconnect_msg(victim), source=("10.0.0.1", 7000))
+    assert victim not in node.membership.neighbors()
+
+    # loopback aliasing: a "localhost"-bound node's goodbye arrives from
+    # 127.0.0.1 and must still read as self-announced
+    alias_victim = "localhost:9123"
+    node.membership.on_connect(alias_victim)
+    node._last_seen[alias_victim] = time.monotonic()
+    node.handle_message(
+        wire.disconnect_msg(alias_victim), source=("127.0.0.1", 9123)
+    )
+    assert alias_victim not in node.membership.neighbors()
+
+
 def test_mesh_pseudo_peers(engine):
     port = free_port()
     node = P2PNode("127.0.0.1", port, engine=engine, mesh_peer_count=4)

@@ -193,3 +193,39 @@ def test_roundtrip():
 
 def test_parse_address():
     assert wire.parse_address("10.0.0.2:7123") == ("10.0.0.2", 7123)
+
+
+def test_canonical_host_loopback_aliases():
+    """Every loopback spelling maps to one identity; real hosts are only
+    case-folded (no DNS on the UDP receive path)."""
+    for alias in ("localhost", "LOCALHOST", "127.0.0.1", "127.0.1.1",
+                  "127.255.255.254", "::1", "ip6-localhost"):
+        assert wire.canonical_host(alias) == "127.0.0.1", alias
+    assert wire.canonical_host("10.0.0.2") == "10.0.0.2"
+    assert wire.canonical_host("Node-A.example") == "node-a.example"
+    # "127.x" shorthand that is not a 4-octet literal stays as-is
+    assert wire.canonical_host("127.fake") == "127.fake"
+
+
+def test_same_endpoint_host_and_port():
+    assert wire.same_endpoint(("localhost", 7000), ("127.0.0.1", 7000))
+    assert wire.same_endpoint(("127.0.1.1", 7000), ("127.0.0.1", 7000))
+    # same port on a DIFFERENT host is a different endpoint (the
+    # goodbye-vs-rumor fix, net/node.py)
+    assert not wire.same_endpoint(("10.0.0.2", 7000), ("10.0.0.1", 7000))
+    assert not wire.same_endpoint(("10.0.0.1", 7001), ("10.0.0.1", 7000))
+
+
+def test_same_endpoint_hostname_falls_back_to_port_only():
+    """code-review PR 2: a node announced by HOSTNAME sends goodbyes from
+    an IP no receiver can compare without DNS — the match must fall back
+    to port-only there (pre-PR-2 behavior) instead of misreading every
+    such node's own goodbye as a rumor."""
+    assert wire.same_endpoint(("10.0.0.9", 7000), ("svc-a", 7000))
+    assert not wire.same_endpoint(("10.0.0.9", 7001), ("svc-a", 7000))
+    # IP-literal announcements keep the strict comparison
+    assert not wire.same_endpoint(("10.0.0.9", 7000), ("10.0.0.1", 7000))
+    assert wire.is_ip_literal("10.0.0.1")
+    assert wire.is_ip_literal("::1")
+    assert not wire.is_ip_literal("svc-a")
+    assert not wire.is_ip_literal("999.0.0.1")
