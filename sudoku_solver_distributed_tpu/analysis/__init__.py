@@ -1,0 +1,67 @@
+"""graftcheck: AST-based static analyzers gating this repo's build.
+
+The stack grew from the paper's 960-LoC single-file node into a ~9k-LoC
+threaded serving system: a dozen ``threading.Lock``/``Condition``
+instances across the node, coalescer, admission and membership layers, a
+JAX device hot path, and a hand-rolled UDP/HTTP JSON protocol whose
+producers and consumers can silently drift (the goodbye-vs-rumor
+port-only bug fixed in PR 2 was exactly that class). These analyzers
+mechanically prove the invariants the serving PRs established by hand,
+so the next cross-thread or cross-host feature cannot quietly regress
+them — serving stacks pair schedulers with correctness tooling, not
+review alone (cf. Orca's batch-scheduler invariants, PAPERS.md).
+
+Three analyzers, all stdlib-``ast``, no third-party deps, no imports of
+the code under analysis (pure source analysis — safe to run anywhere,
+including hosts without jax):
+
+  * ``locks``       — lock-discipline: lock-order cycles, blocking calls
+                      while holding a lock, condition-on-foreign-lock,
+                      guarded-attribute write races (LOCK1xx).
+  * ``jax_hygiene`` — serving-path JAX hygiene: implicit host syncs on
+                      device values, Python branches on traced values,
+                      non-hashable static args, uncached jit factories
+                      (JAX1xx).
+  * ``wire_schema`` — wire-protocol drift: the key sets each ``wire.py``
+                      constructor produces vs the keys each UDP handler
+                      consumes, per message ``type`` (WIRE1xx).
+
+Usage::
+
+    python -m sudoku_solver_distributed_tpu.analysis            # report
+    python -m sudoku_solver_distributed_tpu.analysis --strict   # gate
+
+Library API::
+
+    from sudoku_solver_distributed_tpu import analysis
+    findings = analysis.run_analyzers(analysis.default_config())
+
+Suppression is ONLY via the committed baseline file
+(``analysis/baseline.toml``): new violations fail ``--strict`` while
+baselined legacy ones stay visible debt, each entry carrying an in-file
+``reason``. There are no inline suppression comments by design.
+"""
+
+from __future__ import annotations
+
+from .findings import (  # noqa: F401
+    BaselineEntry,
+    Finding,
+    apply_baseline,
+    load_baseline,
+)
+from .runner import (  # noqa: F401
+    Config,
+    default_config,
+    run_analyzers,
+)
+
+__all__ = [
+    "BaselineEntry",
+    "Config",
+    "Finding",
+    "apply_baseline",
+    "default_config",
+    "load_baseline",
+    "run_analyzers",
+]

@@ -1,0 +1,554 @@
+"""JAX hot-path hygiene analyzer (JAX1xx) for serving-path modules.
+
+The serving path (``engine.py``, ``parallel/``) has a hard contract: one
+device→host transfer per request, at a documented sync point, on warm
+pre-compiled programs. Three hazard classes silently break it:
+
+  * an *implicit* host sync — ``np.asarray``/``np.array``/``float``/
+    ``int``/``bool``/``.item()``/``jax.device_get`` applied to a device
+    value — stalls the calling thread mid-pipeline where nobody expects
+    a transfer; the allowed form is an explicit
+    ``jax.block_until_ready`` at the documented sync point (it launders
+    the taint: its result reads as host-safe);
+  * a Python branch on a *traced* value inside a jitted function either
+    fails at trace time or, with unhashable workarounds, forces
+    retraces;
+  * re-tracing hazards: ``jax.jit`` re-invoked per call in an uncached
+    factory (every call builds a fresh closure → a fresh trace), and
+    mutable literals passed for ``static_argnums``/``static_argnames``
+    parameters (unhashable → TypeError at call time).
+
+Rules:
+
+  JAX101 (error)   implicit host sync on a device-derived value in a
+                   serving-path module.
+  JAX102 (error)   Python ``if``/``while``/``assert`` on a traced value
+                   inside a jit-compiled function.
+  JAX103 (error)   mutable literal (list/dict/set) passed for a static
+                   jit argument.
+  JAX104 (error)   ``jax.jit`` called inside a function that is neither
+                   module setup (``__init__``) nor memoized with
+                   ``functools.lru_cache``/``cache`` — a per-call trace.
+
+Device taint is tracked per function: calls to jit-made callables
+(``self.X = jax.jit(...)`` attributes, ``name = jax.jit(...)`` locals,
+and jit *factories* — functions returning jit objects, resolved to a
+fixed point so ``racer = _make_racer(...)`` counts), ``jnp.*`` calls and
+``jax.device_put`` are sources; attribute/subscript/arith propagate;
+``.shape``/``.dtype``/``.ndim``/``.size`` are static metadata and drop
+the taint, as does an explicit sync. Function parameters are untainted
+by default (host arrays until proven otherwise), so ``np.asarray(board,
+np.int32)``-style ingress normalization never false-positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._astutil import Module, assign_targets, decorator_names, self_attr
+from .findings import Finding
+
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_STATIC_META = {"shape", "dtype", "ndim", "size", "sharding"}
+_MEMO_DECORATORS = {
+    "functools.lru_cache",
+    "functools.cache",
+    "lru_cache",
+    "cache",
+}
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+
+def _is_jax_name(mod: Module, node: ast.AST, dotted: str) -> bool:
+    resolved = mod.resolve_name(node)
+    return resolved == dotted
+
+
+def _jit_call(mod: Module, node: ast.AST) -> Optional[ast.Call]:
+    """The ast.Call if ``node`` is a ``jax.jit(...)`` call."""
+    if isinstance(node, ast.Call) and mod.resolve_call(node) == "jax.jit":
+        return node
+    return None
+
+
+class _ModuleIndex:
+    """Module-wide pass: which names are jit-made callables, which
+    functions are jit factories, which self attributes hold jitted
+    programs."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.jit_attrs: Set[str] = set()       # self.X = jax.jit(...)
+        self.jit_globals: Set[str] = set()     # module-level X = jax.jit(..)
+        self.jit_factories: Set[str] = set()   # def f(): return jax.jit(..)
+        self.jitted_defs: List[Tuple[ast.FunctionDef, str]] = []
+        self._index()
+
+    def _index(self):
+        mod = self.mod
+        # self.X = jax.jit(...) anywhere (engine builds them in __init__)
+        for node in ast.walk(mod.tree):
+            for target, value in assign_targets(node) if isinstance(
+                node, (ast.Assign, ast.AnnAssign, ast.AugAssign)
+            ) else []:
+                name = self_attr(target)
+                if name and _jit_call(mod, value) is not None:
+                    self.jit_attrs.add(name)
+
+        # jit factories to a fixed point: a function whose return value
+        # is a jax.jit call, a jit-assigned local, or a call to another
+        # factory — tuple returns propagate elementwise
+        funcs = {
+            n.name: n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in funcs.items():
+                if name in self.jit_factories:
+                    continue
+                if self._returns_jit(fn):
+                    self.jit_factories.add(name)
+                    changed = True
+
+        # module-level jitted programs: X = jax.jit(...) (or a factory
+        # call) at top level — callable from every function in the module
+        for stmt in mod.tree.body:
+            for target, value in assign_targets(stmt):
+                if not isinstance(target, ast.Name):
+                    continue
+                if _jit_call(mod, value) is not None:
+                    self.jit_globals.add(target.id)
+                elif isinstance(value, ast.Call) and (
+                    isinstance(value.func, ast.Name)
+                    and value.func.id in self.jit_factories
+                ):
+                    self.jit_globals.add(target.id)
+
+        # jitted function defs: def f wrapped as jax.jit(f) or @jax.jit,
+        # plus lambdas/defs passed directly to jax.jit — JAX102's scope
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(
+                    self.mod.resolve_name(
+                        d.func if isinstance(d, ast.Call) else d
+                    )
+                    == "jax.jit"
+                    for d in node.decorator_list
+                ):
+                    self.jitted_defs.append((node, node.name))
+            call = _jit_call(self.mod, node)
+            if call is not None and call.args:
+                arg = call.args[0]
+                if isinstance(arg, ast.Name) and arg.id in funcs:
+                    self.jitted_defs.append((funcs[arg.id], arg.id))
+
+    def _returns_jit(self, fn: ast.FunctionDef) -> bool:
+        jit_locals: Set[str] = set()
+        for stmt in ast.walk(fn):
+            for target, value in assign_targets(stmt):
+                if not isinstance(target, ast.Name):
+                    continue
+                if self._is_jit_expr(value, jit_locals):
+                    jit_locals.add(target.id)
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                values = (
+                    stmt.value.elts
+                    if isinstance(stmt.value, ast.Tuple)
+                    else [stmt.value]
+                )
+                if any(self._is_jit_expr(v, jit_locals) for v in values):
+                    return True
+        return False
+
+    def _is_jit_expr(self, value: ast.AST, jit_locals: Set[str]) -> bool:
+        if _jit_call(self.mod, value) is not None:
+            return True
+        if isinstance(value, ast.Name) and value.id in jit_locals:
+            return True
+        if isinstance(value, ast.Call):
+            callee = value.func
+            if isinstance(callee, ast.Name) and callee.id in (
+                self.jit_factories
+            ):
+                return True
+        return False
+
+
+class _TaintWalker:
+    """Per-function device-taint pass (JAX101) — two sweeps so names
+    assigned late still taint uses inside earlier loop bodies."""
+
+    def __init__(
+        self,
+        mod: Module,
+        index: _ModuleIndex,
+        fn: ast.FunctionDef,
+        symbol: str,
+        findings: List[Finding],
+        pre_tainted: Optional[Set[str]] = None,
+        rule: str = "JAX101",
+    ):
+        self.mod = mod
+        self.index = index
+        self.fn = fn
+        self.symbol = symbol
+        self.findings = findings
+        self.rule = rule
+        self.tainted: Set[str] = set(pre_tainted or ())
+        self.device_fns: Set[str] = set()   # local names bound to jitted fns
+
+    def run(self):
+        self.sweep()
+        self._flag_syncs()
+
+    def sweep(self):
+        """Propagate taint through the function's assignments — two
+        passes so names assigned late still taint uses inside earlier
+        loop bodies. Shared by JAX101 (run) and JAX102
+        (_traced_branch_findings)."""
+        for _ in range(2):
+            for stmt in ast.walk(self.fn):
+                for target, value in assign_targets(stmt):
+                    self._assign(target, value)
+
+    def _assign(self, target: ast.expr, value: ast.expr):
+        if isinstance(target, ast.Name):
+            if self._is_device_fn_expr(value):
+                self.device_fns.add(target.id)
+            elif self._is_tainted(value):
+                self.tainted.add(target.id)
+
+    def _is_device_fn_expr(self, value: ast.expr) -> bool:
+        if _jit_call(self.mod, value) is not None:
+            return True
+        if isinstance(value, ast.Call):
+            callee = value.func
+            if (
+                isinstance(callee, ast.Name)
+                and callee.id in self.index.jit_factories
+            ):
+                return True
+        return False
+
+    def _is_device_call(self, call: ast.Call) -> bool:
+        func = call.func
+        resolved = self.mod.resolve_call(call)
+        if resolved is not None:
+            if resolved.startswith("jax.numpy."):
+                return True
+            if resolved in ("jax.device_put",):
+                return True
+        if isinstance(func, ast.Name) and (
+            func.id in self.device_fns
+            or func.id in self.index.jit_globals
+        ):
+            return True
+        attr = self_attr(func)
+        if attr is not None and attr in self.index.jit_attrs:
+            return True
+        return False
+
+    def _is_tainted(self, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Call):
+            if self._is_device_call(expr):
+                return True
+            # explicit sync launders: jax.block_until_ready(x) is host-safe
+            if self.mod.resolve_call(expr) == "jax.block_until_ready":
+                return False
+            # the sync calls themselves return host values
+            if self._sync_kind(expr) is not None:
+                return False
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_META:
+                return False
+            return self._is_tainted(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self._is_tainted(expr.value)
+        if isinstance(expr, ast.BinOp):
+            return self._is_tainted(expr.left) or self._is_tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self._is_tainted(expr.operand)
+        if isinstance(expr, ast.Compare):
+            return self._is_tainted(expr.left) or any(
+                self._is_tainted(c) for c in expr.comparators
+            )
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._is_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return self._is_tainted(expr.body) or self._is_tainted(
+                expr.orelse
+            )
+        if isinstance(expr, ast.Starred):
+            return self._is_tainted(expr.value)
+        return False
+
+    def _sync_kind(self, call: ast.Call) -> Optional[str]:
+        """The human name of the implicit sync this call performs, or
+        None. ``jax.block_until_ready`` is NOT here — it is the allowed
+        explicit form."""
+        func = call.func
+        resolved = self.mod.resolve_call(call)
+        if resolved in ("numpy.asarray", "numpy.array"):
+            return resolved.replace("numpy.", "np.")
+        if resolved == "jax.device_get":
+            return "jax.device_get"
+        if (
+            isinstance(func, ast.Name)
+            and func.id in _SYNC_BUILTINS
+            and func.id not in self.tainted
+        ):
+            return f"{func.id}()"
+        if isinstance(func, ast.Attribute) and func.attr == "item":
+            return ".item()"
+        return None
+
+    def _flag_syncs(self):
+        for node in ast.walk(self.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._sync_kind(node)
+            if kind is None:
+                continue
+            if kind == ".item()":
+                args = [node.func.value]
+            else:
+                args = list(node.args)
+            if any(self._is_tainted(a) for a in args):
+                self.findings.append(
+                    Finding(
+                        self.rule,
+                        "error",
+                        self.mod.rel_path,
+                        node.lineno,
+                        self.symbol,
+                        f"implicit host sync: {kind} on a device value — "
+                        f"use an explicit jax.block_until_ready at a "
+                        f"documented sync point",
+                    )
+                )
+
+
+def _traced_branch_findings(
+    mod: Module, index: _ModuleIndex, findings: List[Finding]
+):
+    """JAX102: Python control flow on traced values inside jitted defs."""
+    for fn, name in index.jitted_defs:
+        symbol = _symbol_for(mod, fn)
+        params = {
+            a.arg
+            for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            )
+            if a.arg != "self"
+        }
+        walker = _TaintWalker(
+            mod, index, fn, symbol, [], pre_tainted=params
+        )
+        walker.sweep()
+        for node in ast.walk(fn):
+            test = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            if test is not None and walker._is_tainted(test):
+                findings.append(
+                    Finding(
+                        "JAX102",
+                        "error",
+                        mod.rel_path,
+                        node.lineno,
+                        symbol,
+                        f"Python branch on a traced value inside jitted "
+                        f"function {name!r} — fails at trace time or "
+                        f"forces retraces; use lax.cond/select",
+                    )
+                )
+
+
+def _static_arg_findings(
+    mod: Module, index: _ModuleIndex, findings: List[Finding]
+):
+    """JAX103: mutable literals at static jit parameters. Resolved for
+    jit calls that name their function and are assigned to a local/attr
+    that is then called in the same module."""
+    static_of: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    # find assignments `f = jax.jit(..., static_...)` then calls `f(...)`
+    for stmt in ast.walk(mod.tree):
+        for target, value in assign_targets(stmt):
+            call = _jit_call(mod, value)
+            if call is None:
+                continue
+            nums, names = set(), set()
+            for kw in call.keywords:
+                if kw.arg == "static_argnums":
+                    nums |= _int_elts(kw.value)
+                elif kw.arg == "static_argnames":
+                    names |= _str_elts(kw.value)
+            if not nums and not names:
+                continue
+            tname = (
+                target.id
+                if isinstance(target, ast.Name)
+                else self_attr(target)
+            )
+            if tname:
+                static_of[tname] = (nums, names)
+    if not static_of:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = (
+            func.id if isinstance(func, ast.Name) else self_attr(func)
+        )
+        if fname not in static_of:
+            continue
+        nums, names = static_of[fname]
+        for i, arg in enumerate(node.args):
+            if i in nums and isinstance(arg, _MUTABLE_LITERALS):
+                findings.append(_static_finding(mod, node, fname, f"#{i}"))
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(kw.value, _MUTABLE_LITERALS):
+                findings.append(
+                    _static_finding(mod, node, fname, kw.arg or "?")
+                )
+
+
+def _static_finding(mod, node, fname, which) -> Finding:
+    return Finding(
+        "JAX103",
+        "error",
+        mod.rel_path,
+        node.lineno,
+        _symbol_for(mod, node),
+        f"mutable literal passed for static jit argument {which} of "
+        f"{fname!r} — static args must be hashable (use a tuple)",
+    )
+
+
+def _jit_in_function_findings(
+    mod: Module, findings: List[Finding]
+):
+    """JAX104: jax.jit invoked inside a function body without
+    memoization — every call re-traces a fresh closure."""
+
+    def walk(body, owner: Optional[str], memoized: bool, cls: Optional[str]):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                walk(node.body, None, False, node.name)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                memo = memoized or bool(
+                    set(decorator_names(node, mod)) & _MEMO_DECORATORS
+                )
+                # __init__ builds the programs once per object: setup,
+                # not per-call tracing
+                allowed = memo or node.name == "__init__"
+                name = f"{cls}.{node.name}" if cls else node.name
+                walk(node.body, name if not allowed else None, allowed, cls)
+                continue
+            for sub in ast.walk(node):
+                call = _jit_call(mod, sub)
+                if call is not None and owner is not None and not memoized:
+                    findings.append(
+                        Finding(
+                            "JAX104",
+                            "error",
+                            mod.rel_path,
+                            sub.lineno,
+                            owner,
+                            f"jax.jit called inside {owner!r} without "
+                            f"lru_cache memoization — every call traces "
+                            f"a fresh closure (retrace hazard); cache "
+                            f"the jitted program",
+                        )
+                    )
+
+    walk(mod.tree.body, None, False, None)
+
+
+def _symbol_for(mod: Module, node: ast.AST) -> str:
+    """Qualname-ish symbol of the enclosing class.method/function."""
+    target_line = getattr(node, "lineno", 0)
+    best = "<module>"
+    for n in ast.walk(mod.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if n.lineno <= target_line <= max(
+                getattr(n, "end_lineno", n.lineno), n.lineno
+            ):
+                # prefer the innermost enclosing def — walk order is
+                # outer-first, so keep overwriting
+                best = _qual_in_classes(mod, n)
+    return best
+
+
+def _qual_in_classes(mod: Module, fn: ast.FunctionDef) -> str:
+    for cls in mod.classes():
+        for n in ast.walk(cls):
+            if n is fn:
+                return f"{cls.name}.{fn.name}"
+    return fn.name
+
+
+def _int_elts(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+    return out
+
+
+def _str_elts(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+    return out
+
+
+def analyze_module(mod: Module) -> List[Finding]:
+    """All JAX-hygiene rules over one serving-path module."""
+    findings: List[Finding] = []
+    index = _ModuleIndex(mod)
+
+    # JAX101 per function (methods and plain defs, nested included once
+    # as part of their outermost def's walk — ast.walk covers them; run
+    # per top-level def so symbols attribute correctly)
+    seen: Set[int] = set()
+    for cls in mod.classes():
+        for name, fn in (
+            (n.name, n)
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ):
+            seen.add(id(fn))
+            _TaintWalker(
+                mod, index, fn, f"{cls.name}.{name}", findings
+            ).run()
+    for fn in mod.functions():
+        if id(fn) not in seen:
+            _TaintWalker(mod, index, fn, fn.name, findings).run()
+
+    _traced_branch_findings(mod, index, findings)
+    _static_arg_findings(mod, index, findings)
+    _jit_in_function_findings(mod, findings)
+    return findings

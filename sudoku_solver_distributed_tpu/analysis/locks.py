@@ -1,0 +1,616 @@
+"""Lock-discipline analyzer (LOCK1xx).
+
+Per class, from ``__init__``-style assignments, the analyzer types every
+``self.X`` attribute that matters to concurrency — ``threading.Lock`` /
+``RLock`` / ``Condition(lock)`` / ``Event``, ``queue.Queue`` (bounded vs
+unbounded), sockets, threads — then walks each method tracking the
+ordered set of locks held through ``with self.X:`` nesting, with a
+transitive pass over intra-class ``self.method()`` calls.
+
+Rules:
+
+  LOCK101 (error)   lock-order cycle: two with-nestings acquire the same
+                    pair of locks in opposite orders somewhere in the
+                    class — the classic ABBA deadlock.
+  LOCK102 (error)   blocking call while holding a lock: ``Future.result``,
+                    ``Queue.get`` (always) / ``put`` (bounded queues),
+                    socket I/O, ``Thread.join``, ``Event.wait``,
+                    ``time.sleep``, ``jax.block_until_ready`` /
+                    ``jax.device_get`` — directly in a with-region or via
+                    an intra-class call chain. A ``Condition.wait`` on a
+                    HELD lock is exempt locally (waiting releases that
+                    lock) but still blocks any OTHER lock a caller holds,
+                    and propagates as such.
+  LOCK103 (warning) guarded-attribute violation: an attribute written
+                    under the class lock at one site and with no lock at
+                    another (``__init__`` excluded). Private helpers
+                    inherit the locks every intra-class call site is
+                    guaranteed to hold, so ``*_locked``-style helpers
+                    don't false-positive.
+  LOCK104 (error)   self-deadlock: a non-reentrant lock (re-)acquired —
+                    directly or through a call chain — while already held.
+  LOCK105 (error)   ``Condition.wait`` while holding a DIFFERENT lock
+                    than the condition's own: the wait releases only its
+                    own lock, so everything else stays held for the full
+                    sleep.
+
+Scope limits (deliberate, documented): attribute-level tracking only
+(lock objects passed around in locals are not followed), intra-class
+call graphs only (``self.other_object.method()`` is not traversed), and
+nested ``def``s are analyzed with the locks held at their definition
+site (a closure defined under a lock is almost always called under it
+in this codebase's dispatcher/handler idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ._astutil import (
+    Module,
+    assign_targets,
+    call_kw,
+    methods_of,
+    self_attr,
+)
+from .findings import Finding
+
+# attribute kinds
+LOCK, RLOCK, CONDITION, EVENT, QUEUE, SOCKET, THREAD = range(7)
+
+_LOCK_CTORS = {
+    "threading.Lock": LOCK,
+    "threading.RLock": RLOCK,
+    "threading.Condition": CONDITION,
+    "threading.Event": EVENT,
+    "queue.Queue": QUEUE,
+    "queue.LifoQueue": QUEUE,
+    "queue.PriorityQueue": QUEUE,
+    "socket.socket": SOCKET,
+    "socket.create_server": SOCKET,
+    "socket.create_connection": SOCKET,
+    "threading.Thread": THREAD,
+}
+
+_SOCKET_BLOCKING = {
+    "accept",
+    "connect",
+    "recv",
+    "recv_into",
+    "recvfrom",
+    "send",
+    "sendall",
+    "sendto",
+}
+
+_GLOBAL_BLOCKING = {
+    "time.sleep": "time.sleep",
+    "socket.create_connection": "socket.create_connection",
+    "jax.block_until_ready": "jax.block_until_ready (device sync)",
+    "jax.device_get": "jax.device_get (device fetch)",
+}
+
+
+@dataclasses.dataclass
+class _Attr:
+    kind: int
+    bounded: bool = False          # queues: maxsize given and non-zero
+    cond_lock: Optional[str] = None  # conditions: underlying lock attr
+
+
+@dataclasses.dataclass
+class _Block:
+    op: str
+    line: int
+    held: Tuple[str, ...]
+    # Condition.wait on lock L releases L while sleeping: it only blocks
+    # a caller's OTHER locks. None for ops that block unconditionally.
+    releases: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _MethodInfo:
+    name: str
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    edges: List[Tuple[str, str, int]] = dataclasses.field(default_factory=list)
+    blocks: List[_Block] = dataclasses.field(default_factory=list)
+    self_calls: List[Tuple[str, Tuple[str, ...], int]] = dataclasses.field(
+        default_factory=list
+    )
+    writes: List[Tuple[str, FrozenSet[str], int]] = dataclasses.field(
+        default_factory=list
+    )
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+
+
+def _collect_attr_types(
+    mod: Module, methods: Dict[str, ast.FunctionDef]
+) -> Dict[str, _Attr]:
+    """Type self.X attributes from constructor-call assignments anywhere
+    in the class (lazily-created locks/threads included)."""
+    attrs: Dict[str, _Attr] = {}
+    for fn in methods.values():
+        for stmt in ast.walk(fn):
+            for target, value in assign_targets(stmt):
+                name = self_attr(target)
+                if name is None or not isinstance(value, ast.Call):
+                    continue
+                callee = mod.resolve_call(value)
+                kind = _LOCK_CTORS.get(callee or "")
+                if kind is None:
+                    continue
+                attr = _Attr(kind)
+                if kind == QUEUE:
+                    size = (
+                        value.args[0]
+                        if value.args
+                        else call_kw(value, "maxsize")
+                    )
+                    attr.bounded = size is not None and not (
+                        isinstance(size, ast.Constant)
+                        and size.value in (0, None)
+                    )
+                elif kind == CONDITION:
+                    arg = value.args[0] if value.args else None
+                    attr.cond_lock = (
+                        self_attr(arg) if arg is not None else name
+                    )
+                attrs[name] = attr
+    return attrs
+
+
+def _lock_identity(name: str, attrs: Dict[str, _Attr]) -> Optional[str]:
+    """The lock an acquisition of self.<name> actually holds: conditions
+    alias their underlying lock."""
+    attr = attrs.get(name)
+    if attr is None:
+        return None
+    if attr.kind in (LOCK, RLOCK):
+        return name
+    if attr.kind == CONDITION:
+        return attr.cond_lock or name
+    return None
+
+
+def _is_reentrant(name: str, attrs: Dict[str, _Attr]) -> bool:
+    """Conservative reentrancy check that tolerates UNKNOWN locks: a
+    Condition can wrap an attribute the typing pass never saw assigned
+    from a recognized constructor (e.g. a lock injected as an __init__
+    parameter) — such a lock must analyze as plain/non-reentrant, not
+    crash the gate with a KeyError."""
+    attr = attrs.get(name)
+    return attr is not None and attr.kind == RLOCK
+
+
+class _MethodWalker:
+    """One method's local pass: held-lock tracking + site collection."""
+
+    def __init__(
+        self,
+        mod: Module,
+        cls_name: str,
+        attrs: Dict[str, _Attr],
+        method_names: Set[str],
+        info: _MethodInfo,
+        rel_path: str,
+    ):
+        self.mod = mod
+        self.cls_name = cls_name
+        self.attrs = attrs
+        self.method_names = method_names
+        self.info = info
+        self.rel_path = rel_path
+        self.symbol = f"{cls_name}.{info.name}"
+
+    def _finding(self, rule: str, severity: str, line: int, msg: str):
+        self.info.findings.append(
+            Finding(rule, severity, self.rel_path, line, self.symbol, msg)
+        )
+
+    # -- statement walk ----------------------------------------------------
+    def walk_body(self, body: List[ast.stmt], held: Tuple[str, ...]):
+        for stmt in body:
+            self.walk_stmt(stmt, held)
+
+    def walk_stmt(self, stmt: ast.stmt, held: Tuple[str, ...]):
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, new_held)
+                name = self_attr(item.context_expr)
+                lock = _lock_identity(name, self.attrs) if name else None
+                if lock is not None:
+                    new_held = self._acquire(
+                        lock, new_held, stmt.lineno
+                    )
+            self.walk_body(stmt.body, new_held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: analyzed with the defining site's locks (see
+            # module docstring); decorators/defaults scanned too
+            for dec in stmt.decorator_list:
+                self._scan_expr(dec, held)
+            self.walk_body(stmt.body, held)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        # writes
+        for target, _value in assign_targets(stmt):
+            attr = self._written_attr(target)
+            if attr is not None:
+                self.info.writes.append(
+                    (attr, frozenset(held), stmt.lineno)
+                )
+        # expressions in this statement (excluding nested-stmt bodies)
+        for expr in self._stmt_exprs(stmt):
+            self._scan_expr(expr, held)
+        # recurse into compound bodies
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(stmt, field, None)
+            if body:
+                self.walk_body(body, held)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self.walk_body(handler.body, held)
+
+    @staticmethod
+    def _stmt_exprs(stmt: ast.stmt) -> List[ast.expr]:
+        out = []
+        for field, value in ast.iter_fields(stmt):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.expr):
+                out.append(value)
+            elif isinstance(value, list):
+                out.extend(v for v in value if isinstance(v, ast.expr))
+        return out
+
+    @staticmethod
+    def _written_attr(target: ast.expr) -> Optional[str]:
+        # self.X = ..., self.X[...] = ..., self.X.Y = ... all count as
+        # writes into X's guarded state
+        node = target
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            name = self_attr(node)
+            if name is not None:
+                return name
+            node = node.value
+        return None
+
+    # -- acquisition -------------------------------------------------------
+    def _acquire(
+        self, lock: str, held: Tuple[str, ...], line: int
+    ) -> Tuple[str, ...]:
+        if lock in held and not _is_reentrant(lock, self.attrs):
+            self._finding(
+                "LOCK104",
+                "error",
+                line,
+                f"non-reentrant lock self.{lock} re-acquired while "
+                f"already held — self-deadlock",
+            )
+            return held
+        for h in held:
+            if h != lock:
+                self.info.edges.append((h, lock, line))
+        self.info.acquires.add(lock)
+        return held + (lock,) if lock not in held else held
+
+    # -- expression scan ---------------------------------------------------
+    def _scan_expr(self, expr: ast.expr, held: Tuple[str, ...]):
+        # hand-rolled walk that PRUNES lambda subtrees (ast.walk would
+        # descend into them): a lambda merely defined under a lock runs
+        # at an unknown later time, so its body's calls must not inherit
+        # the held set (deferred-callback idiom)
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                self._scan_call(node, held)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_call(self, call: ast.Call, held: Tuple[str, ...]):
+        func = call.func
+        resolved = self.mod.resolve_call(call)
+        if resolved in _GLOBAL_BLOCKING:
+            self._blocking(_GLOBAL_BLOCKING[resolved], call.lineno, held)
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        method = func.attr
+        recv_attr = self_attr(func.value)
+        if recv_attr is not None:
+            attr = self.attrs.get(recv_attr)
+            if attr is not None:
+                self._scan_typed_attr_call(
+                    recv_attr, attr, method, call, held
+                )
+                return
+        # self.method(...) intra-class call
+        if (
+            isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and method in self.method_names
+        ):
+            self.info.self_calls.append((method, held, call.lineno))
+            return
+        # Future.result() on any receiver: .result( is unambiguous in
+        # this codebase (concurrent.futures) and blocks until completion
+        if method == "result":
+            self._blocking("Future.result()", call.lineno, held)
+
+    def _scan_typed_attr_call(
+        self,
+        name: str,
+        attr: _Attr,
+        method: str,
+        call: ast.Call,
+        held: Tuple[str, ...],
+    ):
+        line = call.lineno
+        if attr.kind == QUEUE:
+            if method == "get" or (method == "put" and attr.bounded):
+                kind = "bounded " if attr.bounded and method == "put" else ""
+                self._blocking(
+                    f"{kind}queue self.{name}.{method}()", line, held
+                )
+            elif method == "join":
+                self._blocking(f"queue self.{name}.join()", line, held)
+        elif attr.kind == SOCKET:
+            if method in _SOCKET_BLOCKING:
+                self._blocking(f"socket self.{name}.{method}()", line, held)
+        elif attr.kind == THREAD:
+            if method == "join":
+                self._blocking(f"thread self.{name}.join()", line, held)
+        elif attr.kind == EVENT:
+            if method == "wait":
+                self._blocking(f"event self.{name}.wait()", line, held)
+        elif attr.kind == CONDITION:
+            if method in ("wait", "wait_for"):
+                underlying = attr.cond_lock or name
+                others = [h for h in held if h != underlying]
+                if held and underlying not in held:
+                    self._finding(
+                        "LOCK105",
+                        "error",
+                        line,
+                        f"self.{name}.{method}() waits on "
+                        f"self.{underlying} while holding "
+                        f"{_fmt(held)} — the wait releases only its own "
+                        f"lock",
+                    )
+                elif others:
+                    self._finding(
+                        "LOCK102",
+                        "error",
+                        line,
+                        f"self.{name}.{method}() releases only "
+                        f"self.{underlying}; {_fmt(tuple(others))} "
+                        f"stays held for the whole wait",
+                    )
+                # always record for transitive propagation: callers
+                # holding other locks block here
+                self.info.blocks.append(
+                    _Block(
+                        f"Condition self.{name}.{method}()",
+                        line,
+                        held,
+                        releases=underlying,
+                    )
+                )
+        elif attr.kind in (LOCK, RLOCK):
+            if method == "acquire":
+                lock = _lock_identity(name, self.attrs)
+                if lock:
+                    self._acquire(lock, held, line)
+
+    def _blocking(self, op: str, line: int, held: Tuple[str, ...]):
+        self.info.blocks.append(_Block(op, line, held))
+        if held:
+            self._finding(
+                "LOCK102",
+                "error",
+                line,
+                f"blocking {op} while holding {_fmt(held)}",
+            )
+
+
+def _fmt(locks: Tuple[str, ...]) -> str:
+    return ", ".join(f"self.{name}" for name in locks)
+
+
+def _transitive(
+    infos: Dict[str, _MethodInfo], attrs: Dict[str, _Attr], cls: str, path: str
+) -> List[Finding]:
+    """Propagate blocking ops and acquisitions across intra-class calls,
+    then detect lock-order cycles."""
+    findings: List[Finding] = []
+    # transitive blocking sets: op description per method (first site)
+    blocks: Dict[str, Dict[str, Optional[str]]] = {
+        m: {b.op: b.releases for b in info.blocks}
+        for m, info in infos.items()
+    }
+    acquires: Dict[str, Set[str]] = {
+        m: set(info.acquires) for m, info in infos.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for m, info in infos.items():
+            for callee, _held, _line in info.self_calls:
+                for op, releases in blocks.get(callee, {}).items():
+                    if op not in blocks[m]:
+                        blocks[m][op] = releases
+                        changed = True
+                extra = acquires.get(callee, set()) - acquires[m]
+                if extra:
+                    acquires[m] |= extra
+                    changed = True
+
+    edges: List[Tuple[str, str, int, str]] = []
+    for m, info in infos.items():
+        for a, b, line in info.edges:
+            edges.append((a, b, line, m))
+        for callee, held, line in info.self_calls:
+            if not held:
+                continue
+            symbol = f"{cls}.{m}"
+            # blocking through the call chain
+            blocking_ops = [
+                op
+                for op, releases in blocks.get(callee, {}).items()
+                if releases is None
+                or any(h != releases for h in held)
+            ]
+            if blocking_ops:
+                findings.append(
+                    Finding(
+                        "LOCK102",
+                        "error",
+                        path,
+                        line,
+                        symbol,
+                        f"call to self.{callee}() blocks "
+                        f"({'; '.join(sorted(blocking_ops))}) while "
+                        f"holding {_fmt(held)}",
+                    )
+                )
+            # acquisition through the call chain
+            for lock in sorted(acquires.get(callee, set())):
+                if lock in held and not _is_reentrant(lock, attrs):
+                    findings.append(
+                        Finding(
+                            "LOCK104",
+                            "error",
+                            path,
+                            line,
+                            symbol,
+                            f"call to self.{callee}() re-acquires held "
+                            f"non-reentrant lock self.{lock} — "
+                            f"self-deadlock",
+                        )
+                    )
+                else:
+                    for h in held:
+                        if h != lock:
+                            edges.append((h, lock, line, m))
+
+    # cycle detection over the acquisition-order graph
+    graph: Dict[str, Dict[str, Tuple[int, str]]] = {}
+    for a, b, line, m in edges:
+        graph.setdefault(a, {}).setdefault(b, (line, m))
+    reported: Set[FrozenSet[str]] = set()
+    for a in sorted(graph):
+        for b in sorted(graph[a]):
+            if a in graph.get(b, {}) and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                line, m = graph[a][b]
+                line2, m2 = graph[b][a]
+                findings.append(
+                    Finding(
+                        "LOCK101",
+                        "error",
+                        path,
+                        line,
+                        f"{cls}.{m}",
+                        f"lock-order cycle: self.{a} → self.{b} here, "
+                        f"but self.{b} → self.{a} in {cls}.{m2} "
+                        f"(line {line2}) — ABBA deadlock",
+                    )
+                )
+    return findings
+
+
+def _guarded_attr_findings(
+    infos: Dict[str, _MethodInfo], cls: str, path: str
+) -> List[Finding]:
+    """LOCK103: writes both under a lock and bare. Private helpers get
+    the locks EVERY intra-class call site guarantees (fixed point), so
+    hold-the-lock helpers don't read as bare writers."""
+    all_locks: Set[str] = set()
+    for info in infos.values():
+        for _a, held, _l in info.writes:
+            all_locks |= held
+        all_locks |= info.acquires
+    # guaranteed entry locks per method
+    callers: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+    for m, info in infos.items():
+        for callee, held, _line in info.self_calls:
+            callers.setdefault(callee, []).append((m, held))
+    entry: Dict[str, FrozenSet[str]] = {}
+    for m in infos:
+        is_private = m.startswith("_") and not m.startswith("__")
+        entry[m] = (
+            frozenset(all_locks)
+            if is_private and callers.get(m)
+            else frozenset()
+        )
+    for _ in range(len(infos) + 1):
+        changed = False
+        for m in infos:
+            if not callers.get(m) or entry[m] == frozenset():
+                continue
+            new = frozenset(all_locks)
+            for caller, held in callers[m]:
+                new &= frozenset(held) | entry[caller]
+            if new != entry[m]:
+                entry[m] = new
+                changed = True
+        if not changed:
+            break
+
+    # collect effective write contexts
+    locked: Dict[str, Tuple[str, int, str]] = {}   # attr -> lock, line, m
+    bare: Dict[str, Tuple[int, str]] = {}          # attr -> line, m
+    for m, info in infos.items():
+        if m == "__init__":
+            continue
+        for attr, held, line in info.writes:
+            eff = held | entry[m]
+            if eff:
+                locked.setdefault(attr, (sorted(eff)[0], line, m))
+            else:
+                bare.setdefault(attr, (line, m))
+    findings = []
+    for attr in sorted(set(locked) & set(bare)):
+        lock, lline, lm = locked[attr]
+        bline, bm = bare[attr]
+        findings.append(
+            Finding(
+                "LOCK103",
+                "warning",
+                path,
+                bline,
+                f"{cls}.{bm}",
+                f"self.{attr} written without a lock here but under "
+                f"self.{lock} in {cls}.{lm} (line {lline}) — guarded "
+                f"attribute mutated outside its lock",
+            )
+        )
+    return findings
+
+
+def analyze_module(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in mod.classes():
+        methods = methods_of(cls)
+        attrs = _collect_attr_types(mod, methods)
+        if not any(
+            a.kind in (LOCK, RLOCK, CONDITION) for a in attrs.values()
+        ):
+            continue  # lock-free class: nothing to check
+        infos: Dict[str, _MethodInfo] = {}
+        for name, fn in methods.items():
+            info = _MethodInfo(name)
+            walker = _MethodWalker(
+                mod, cls.name, attrs, set(methods), info, mod.rel_path
+            )
+            walker.walk_body(fn.body, ())
+            infos[name] = info
+            findings.extend(info.findings)
+        findings.extend(_transitive(infos, attrs, cls.name, mod.rel_path))
+        findings.extend(_guarded_attr_findings(infos, cls.name, mod.rel_path))
+    return findings

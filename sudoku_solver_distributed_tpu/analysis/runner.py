@@ -1,0 +1,92 @@
+"""Analyzer orchestration: configuration, module discovery, one entry
+point shared by the CLI (``__main__``) and the tier-1 test suite."""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from . import jax_hygiene, locks, wire_schema
+from ._astutil import Module, iter_modules
+from .findings import Finding
+
+_PKG_DIR = Path(__file__).resolve().parent.parent   # the package
+_REPO_ROOT = _PKG_DIR.parent                        # its checkout
+
+
+@dataclasses.dataclass
+class Config:
+    """What to analyze. Defaults describe THIS repo; tests point the
+    fields at fixture trees."""
+
+    # repo root: findings are reported relative to it
+    root: Path = _REPO_ROOT
+    # package tree the lock analyzer sweeps (every class with a lock)
+    package: Path = _PKG_DIR
+    # serving-path scope for the JAX-hygiene rules, relative to package
+    serving: Tuple[str, ...] = ("engine.py", "parallel")
+    # wire producer + consumer modules, relative to package
+    wire_producer: str = "net/wire.py"
+    wire_consumers: Tuple[str, ...] = (
+        "net/node.py",
+        "net/membership.py",
+        "net/stats.py",
+    )
+    # baseline file (None = no suppression)
+    baseline: Optional[Path] = _PKG_DIR / "analysis" / "baseline.toml"
+    # which analyzers to run
+    analyzers: Tuple[str, ...] = ("locks", "jax", "wire")
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def _is_serving(rel_to_pkg: str, serving: Sequence[str]) -> bool:
+    for entry in serving:
+        if rel_to_pkg == entry or rel_to_pkg.startswith(
+            entry.rstrip("/") + "/"
+        ):
+            return True
+    return False
+
+
+def run_analyzers(config: Optional[Config] = None) -> List[Finding]:
+    """Run the configured analyzers; returns RAW findings (baseline not
+    applied — callers use ``load_baseline``/``apply_baseline``, or the
+    CLI which does it for them)."""
+    cfg = config or default_config()
+    findings: List[Finding] = []
+
+    modules = list(iter_modules(cfg.package, cfg.root))
+    by_rel_pkg = {
+        m.path.relative_to(cfg.package).as_posix(): m for m in modules
+    }
+
+    if "locks" in cfg.analyzers:
+        for mod in modules:
+            findings.extend(locks.analyze_module(mod))
+
+    if "jax" in cfg.analyzers:
+        for rel, mod in by_rel_pkg.items():
+            if _is_serving(rel, cfg.serving):
+                findings.extend(jax_hygiene.analyze_module(mod))
+
+    if "wire" in cfg.analyzers:
+        producer = by_rel_pkg.get(cfg.wire_producer)
+        if producer is None:
+            producer_path = cfg.package / cfg.wire_producer
+            if producer_path.exists():
+                producer = Module(
+                    producer_path,
+                    producer_path.relative_to(cfg.root).as_posix(),
+                )
+        consumers = [
+            by_rel_pkg[c] for c in cfg.wire_consumers if c in by_rel_pkg
+        ]
+        if producer is not None and consumers:
+            findings.extend(wire_schema.analyze(producer, consumers))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

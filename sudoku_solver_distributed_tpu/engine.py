@@ -552,7 +552,13 @@ class SolverEngine:
         Returns the packed (n, C+4) host array: [grid | solved | status |
         guesses | validations] per row.
         """
-        packed = np.array(packed)
+        # THE documented sync point of the bucket path: exactly one
+        # device→host transfer per dispatched batch, made explicit with
+        # block_until_ready (analysis/jax_hygiene.py JAX101 contract).
+        # np.array, not asarray: asarray of a jax Array is a READ-ONLY
+        # view of the device buffer, and the deep-retry merge below
+        # writes into the capped rows
+        packed = np.array(jax.block_until_ready(packed))
         C = self.spec.cells
         running = packed[:, C + 1] == RUNNING
         # trigger on REAL rows only: a deep pass for discarded pad lanes is
@@ -584,7 +590,11 @@ class SolverEngine:
                     ],
                     axis=0,
                 )
-            deep = np.asarray(self._solve_deep(self._device_batch(sub)))
+            deep = np.asarray(
+                jax.block_until_ready(
+                    self._solve_deep(self._device_batch(sub))
+                )
+            )
             first = packed[capped].copy()
             packed[capped] = deep[: len(capped)]
             packed[capped, C + 2] += first[:, C + 2]
@@ -728,7 +738,12 @@ class SolverEngine:
             boards = np.concatenate(
                 [boards, np.zeros((bucket - 1, *arr.shape), arr.dtype)]
             )
-        packed = np.asarray(self._solve_quick(self._device_batch(boards)))
+        # explicit sync at the probe's documented fetch point (JAX101)
+        packed = np.asarray(
+            jax.block_until_ready(
+                self._solve_quick(self._device_batch(boards))
+            )
+        )
         C = self.spec.cells
         row = packed[0]
         status = int(row[C + 1])
@@ -771,7 +786,9 @@ class SolverEngine:
         # unpadded for the stack decomposition, so bypass the sharding (the
         # probe is a single-board program either way; code-review r4)
         packed_dev, st = self._solve_quick_state(jnp.asarray(arr[None]))
-        packed = np.asarray(packed_dev)  # ONE transfer on the common path
+        # ONE transfer on the common path, explicit (JAX101); st stays
+        # device-resident unless the request escalates
+        packed = np.asarray(jax.block_until_ready(packed_dev))
         C = self.spec.cells
         status = int(packed[C])
         validations = int(packed[C + 2])

@@ -585,6 +585,14 @@ class P2PNode:
                         self.task_queue.append((i, j))
 
         while True:
+            # planned dispatches leave the lock region and send after it:
+            # a UDP sendto under _state_lock stalls every thread touching
+            # task state (the UDP loop's solution fold, worker requeues)
+            # for the send's syscall time — the exact blocking-under-lock
+            # class graftcheck flags (analysis/locks.py LOCK102). The
+            # board is snapshotted at planning time so the fold below
+            # can't mutate a message already planned.
+            to_send: List[Tuple[str, wire.Msg]] = []
             with self._state_lock:
                 # reap deadlined assignments (dead/slow peers: the failure
                 # mode the reference cannot detect, SURVEY.md §3.5)
@@ -612,8 +620,13 @@ class P2PNode:
                         continue
                     i, j = self.task_queue.popleft()
                     self.active_tasks[peer] = (i, j, now + TASK_DEADLINE_S)
-                    self.send_to(
-                        peer, wire.solve_msg(board, i, j, self.id)
+                    to_send.append(
+                        (
+                            peer,
+                            wire.solve_msg(
+                                [list(r) for r in board], i, j, self.id
+                            ),
+                        )
                     )
 
                 # fold in any arrived solutions
@@ -640,8 +653,14 @@ class P2PNode:
                         self.task_queue.appendleft((row, col))
 
                 done = not self.task_queue and not self.active_tasks
-                if not done:
+                if not done and not to_send:
+                    # with dispatches planned, skip the wait this round:
+                    # the sends below must not sit on a held lock, and the
+                    # next iteration (nothing new to send) waits as before
                     self._solution_event.wait(timeout=SOLVE_WAIT_SLICE_S)
+
+            for peer, msg in to_send:
+                self.send_to(peer, msg)
 
             if requeued_none or all_workers_gone:
                 # Fall back to the authoritative engine on the original

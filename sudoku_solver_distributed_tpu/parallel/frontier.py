@@ -441,9 +441,14 @@ def frontier_solve(
         global_states = jax.make_array_from_callback(
             host_states.shape, sharding, lambda idx: host_states[idx]
         )
-        packed = np.asarray(racer(global_states))
+        # the race's one documented device→host fetch, explicit
+        # (analysis/jax_hygiene.py JAX101): the packed row is replicated,
+        # every host reads the same bytes
+        packed = np.asarray(jax.block_until_ready(racer(global_states)))
     else:
-        packed = np.asarray(racer(jnp.asarray(states)))
+        packed = np.asarray(
+            jax.block_until_ready(racer(jnp.asarray(states)))
+        )
     C = spec.cells
     found, validations = bool(packed[C]), int(packed[C + 1])
     info = {

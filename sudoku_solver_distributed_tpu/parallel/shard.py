@@ -11,7 +11,7 @@ pair per cell per peer is now one sharded device program per batch.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -21,6 +21,7 @@ from ..ops import BoardSpec, SPEC_9, solve_batch
 from .compat import shard_map
 
 
+@lru_cache(maxsize=None)
 def make_sharded_solver(
     mesh: Mesh,
     spec: BoardSpec = SPEC_9,
@@ -42,6 +43,12 @@ def make_sharded_solver(
     ``locked_candidates``/``waves`` default to the measured single-chip
     winners (ops/solver.py; v5e 2026-07-30) so the sharded path runs the
     same optimized kernel per shard as the serving engine.
+
+    Memoized on every knob (same contract as frontier._make_racer_cached,
+    found by analysis/jax_hygiene.py JAX104): each call used to build a
+    fresh ``_solve_shard`` closure, so two calls with identical arguments
+    compiled two identical programs — callers that construct a solver
+    per batch now share one trace per configuration.
     """
     data_spec = P("data")
 

@@ -229,3 +229,157 @@ def test_same_endpoint_hostname_falls_back_to_port_only():
     assert wire.is_ip_literal("::1")
     assert not wire.is_ip_literal("svc-a")
     assert not wire.is_ip_literal("999.0.0.1")
+
+
+# -- producer→handler roundtrip (runtime complement of the static
+#    wire-schema analyzer, sudoku_solver_distributed_tpu/analysis) ----------
+#
+# graftcheck's WIRE1xx rules prove producer/consumer key-set agreement
+# from SOURCE; these tests prove it at RUNTIME: every wire.py
+# constructor's output, passed through encode/decode, must clear the
+# handler's ingress validation and dispatch into real node state — no
+# "dropping"/"malformed" warning, and the type's expected state effect
+# happens. A constructor key rename that somehow slipped past the
+# static check dies here instead of in production gossip.
+
+import logging
+import time as _time
+
+import pytest
+
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+
+PEER = "127.0.0.1:7001"
+PEER_SRC = ("127.0.0.1", 7001)
+BOARD9 = [[0] * 9 for _ in range(9)]
+
+
+class _InstantEngine:
+    """Engine stub: handle_message paths touch only these surfaces."""
+
+    validations = 0
+    frontier_enabled = False
+
+    def solve_one(self, board, frontier=None):
+        return [list(r) for r in board], {"validations": 0}
+
+
+@pytest.fixture
+def quiet_node(monkeypatch):
+    node = P2PNode(
+        "127.0.0.1", 7990, engine=_InstantEngine(), failure_timeout=0.0
+    )
+    sent = []
+    monkeypatch.setattr(
+        node, "_raw_send", lambda addr, msg: sent.append((addr, msg))
+    )
+    node.sent_msgs = sent
+    yield node
+    node.shutdown_flag = True
+
+
+def _wait(pred, timeout=5.0):
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        if pred():
+            return True
+        _time.sleep(0.01)
+    return pred()
+
+
+def _deliver(node, msg):
+    node.handle_message(wire.decode_msg(wire.encode_msg(msg)), source=PEER_SRC)
+
+
+def _check_connect(node):
+    assert PEER in node.membership.peers_out
+    assert any(m["type"] == "connected" for _a, m in node.sent_msgs)
+
+
+def _check_connected(node):
+    assert PEER in node.membership.peers_in
+    assert node.membership.all_peers[PEER] == [node.id]
+
+
+def _check_all_peers(node):
+    assert node.membership.all_peers.get(PEER) == ["127.0.0.1:7002"]
+
+
+def _check_disconnect(node):
+    assert PEER not in node.membership.peers_out
+
+
+def _check_solve(node):
+    # the worker thread answers the farmed cell with a solution message
+    assert _wait(
+        lambda: any(m["type"] == "solution" for _a, m in node.sent_msgs)
+    )
+
+
+def _check_solution(node):
+    assert list(node.solution_queue) == [(2, 3, 7, PEER)]
+
+
+def _check_stats(node):
+    merged = node.get_stats()
+    assert {"address": PEER, "validations": 11} in merged["nodes"]
+
+
+ROUNDTRIP_CASES = [
+    ("connect", lambda: wire.connect_msg(PEER), _check_connect),
+    ("connected", lambda: wire.connected_msg(PEER), _check_connected),
+    (
+        "all_peers",
+        lambda: wire.all_peers_msg({PEER: ["127.0.0.1:7002"]}),
+        _check_all_peers,
+    ),
+    ("disconnect", lambda: wire.disconnect_msg(PEER), _check_disconnect),
+    (
+        "disconnect_mid_task",
+        lambda: wire.disconnect_msg(PEER, (4, 8)),
+        _check_disconnect,
+    ),
+    ("solve", lambda: wire.solve_msg(BOARD9, 0, 0, PEER), _check_solve),
+    (
+        "solution",
+        lambda: wire.solution_msg(BOARD9, 2, 3, 7, PEER),
+        _check_solution,
+    ),
+    (
+        "stats",
+        lambda: wire.stats_msg(
+            PEER,
+            3,
+            11,
+            {"all": {"solved": 3, "validations": 11}, "nodes": []},
+        ),
+        _check_stats,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,build,check",
+    ROUNDTRIP_CASES,
+    ids=[c[0] for c in ROUNDTRIP_CASES],
+)
+def test_constructor_output_accepted_by_handler(
+    quiet_node, caplog, name, build, check
+):
+    if name.startswith("disconnect"):
+        # a departure only has an effect on a known peer
+        _deliver(quiet_node, wire.connect_msg(PEER))
+        quiet_node.sent_msgs.clear()
+    with caplog.at_level(
+        logging.WARNING, logger="sudoku_solver_distributed_tpu.net.node"
+    ):
+        _deliver(quiet_node, build())
+    rejected = [
+        r.message
+        for r in caplog.records
+        if "dropping" in r.getMessage()
+        or "malformed" in r.getMessage()
+        or "unknown message type" in r.getMessage()
+    ]
+    assert rejected == [], f"{name} rejected by its handler: {rejected}"
+    check(quiet_node)
