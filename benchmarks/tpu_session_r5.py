@@ -339,10 +339,22 @@ def main():
                 buckets=(1,),
                 frontier_mesh=mesh,
                 frontier_states_per_device=64,
+                # persistent plane (compilecache/): artifacts baked in an
+                # earlier window load instead of re-compiling; the XLA
+                # layer keeps riding COMPILE_CACHE_DIR (first-wins)
+                compile_cache_dir=os.environ.get(
+                    "TPU_COMPILE_PLANE_DIR",
+                    os.path.join(REPO, "benchmarks", ".compile_plane"),
+                ),
             )
             dog.arm("engine_warmup")
-            eng.warmup()
+            # budgeted tiered warmup (ISSUE 4): tier 0 always compiles;
+            # a short window skips the wide rungs instead of dying in them
+            eng.warmup(
+                budget_s=float(os.environ.get("TPU_WARMUP_BUDGET_S", "240"))
+            )
             dog.disarm()
+            emit({"phase": "engine_warm_info", **eng.warm_info()})
         except Exception as e:  # noqa: BLE001
             emit({"phase": "error", "name": "crossover_setup", "err": repr(e)[:600]})
             eng = None

@@ -1,0 +1,31 @@
+"""Cold-start compiler plane: persistent XLA cache wiring + AOT artifacts.
+
+Two layers, both rooted under one operator-chosen directory (CLI
+``--compile-cache-dir`` / ``SUDOKU_COMPILE_CACHE_DIR``):
+
+  * ``<dir>/xla`` — jax's own persistent compilation cache, keyed
+    implicitly by XLA (HLO fingerprint): any trace-and-compile that
+    happened once on this backend is a disk hit next process.
+  * ``<dir>/aot`` — our explicit ahead-of-time artifact store
+    (``AotStore``): serialized compiled executables keyed by
+    (program, board spec, bucket, solver config) + a backend
+    fingerprint, loaded with ``jax.experimental.serialize_executable``
+    so a warm start skips even the trace. Artifacts are never trusted
+    blindly — the engine verifies one round-trip solve against ground
+    truth before serving from one, and any load/verify failure falls
+    back to ordinary trace-and-compile (never a correctness risk).
+"""
+
+from .store import (
+    AotStore,
+    backend_fingerprint,
+    enable_persistent_cache,
+    program_key,
+)
+
+__all__ = [
+    "AotStore",
+    "backend_fingerprint",
+    "enable_persistent_cache",
+    "program_key",
+]

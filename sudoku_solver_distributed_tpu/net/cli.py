@@ -55,12 +55,23 @@ Extensions (defaults preserve reference behavior):
                 coordinator ("host:port") so the engine's mesh spans a pod
                 slice; the P2P/HTTP control plane is unchanged (SURVEY.md §5
                 distributed-backend row)
+  --compile-cache-dir / --warmup-budget-s
+                cold-start compiler plane (compilecache/, engine.warmup):
+                the cache dir roots jax's persistent XLA cache plus the
+                explicit AOT artifact store (env default
+                SUDOKU_COMPILE_CACHE_DIR), so compiles paid once are disk
+                reads forever after; the warmup budget bounds background
+                ladder widening so a short TPU claim window spends its
+                seconds on the buckets the bench will hit — tier 0 (the
+                smallest + coalescer-preferred buckets) always compiles,
+                and /solve is servable the moment it has compiled
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
+import os
 import threading
 
 
@@ -176,6 +187,26 @@ def build_parser() -> argparse.ArgumentParser:
         "board's iterations across the full width (engine.py rationale)",
     )
     parser.add_argument(
+        "--compile-cache-dir",
+        default=os.environ.get("SUDOKU_COMPILE_CACHE_DIR") or None,
+        help="root of the persistent compile plane (compilecache/): "
+        "<dir>/xla hosts jax's persistent compilation cache, <dir>/aot "
+        "the explicit AOT executable store warmup loads verified "
+        "artifacts from (and bakes new ones into). Env default: "
+        "SUDOKU_COMPILE_CACHE_DIR. Unset (default): no persistence, "
+        "every process compiles from scratch",
+    )
+    parser.add_argument(
+        "--warmup-budget-s",
+        type=float,
+        default=0.0,
+        help="bound the background warmup's ladder widening to this many "
+        "seconds: tier 0 (smallest + coalescer-preferred buckets) always "
+        "compiles and flips serving warm; buckets past the budget are "
+        "skipped and requests tile over the warm widths instead "
+        "(engine.warmup). 0 (default) = no budget, warm the full ladder",
+    )
+    parser.add_argument(
         "--profile-dir", default=None, help="jax.profiler trace output dir"
     )
     parser.add_argument(
@@ -287,6 +318,7 @@ def main(argv=None) -> None:
         "coalesce_max_wait_s": args.coalesce_max_wait_ms / 1e3,
         "coalesce_max_batch": args.coalesce_max_batch,
         "coalesce_adaptive": args.adaptive_coalesce,
+        "compile_cache_dir": args.compile_cache_dir,
     }
     if args.buckets:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
@@ -350,8 +382,14 @@ def main(argv=None) -> None:
         node.engine.profile_dir = args.profile_dir
     if not args.no_warmup:
         # pre-compile the serving buckets so the first /solve is warm
-        # (p50 <5 ms contract, engine.SolverEngine.warmup)
-        threading.Thread(target=node.engine.warmup, daemon=True).start()
+        # (p50 <5 ms contract, engine.SolverEngine.warmup). Tiered: the
+        # thread flips `warmed` the moment tier 0 compiles, then widens
+        # the ladder — bounded by --warmup-budget-s when set
+        threading.Thread(
+            target=node.engine.warmup,
+            kwargs={"budget_s": args.warmup_budget_s or None},
+            daemon=True,
+        ).start()
 
     httpd = make_http_server(
         node, args.host, args.p,

@@ -148,12 +148,29 @@ class FastHTTPServer:
         ).start()
 
     def _worker_loop(self) -> None:
-        while not self._shutdown:
-            try:
-                conn = self._conns.get(timeout=1.0)
-            except queue.Empty:
-                continue  # poll the shutdown flag; workers live with the server
-            self._serve_connection(conn)
+        # the catch-all matters: _serve_connection absorbs (OSError,
+        # ValueError), but ANY other exception escaping a route core used
+        # to kill this thread with _workers never decremented — enough
+        # repeated faults wedged the pool permanently while accepts kept
+        # queueing (ROADMAP fastserve-hardening (a)). Now a faulting
+        # connection is logged and dropped, the worker lives on, and the
+        # finally keeps the pool count honest even if the worker does die.
+        try:
+            while not self._shutdown:
+                try:
+                    conn = self._conns.get(timeout=1.0)
+                except queue.Empty:
+                    continue  # poll the shutdown flag; workers live with the server
+                try:
+                    self._serve_connection(conn)
+                except Exception:  # noqa: BLE001 — fail the connection, not the pool
+                    logger.exception(
+                        "connection handler crashed — connection dropped, "
+                        "worker continues"
+                    )
+        finally:
+            with self._pool_lock:
+                self._workers -= 1
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -269,6 +286,19 @@ class FastHTTPServer:
             or b"chunked" in te
             or content_length > _MAX_BODY
         )
+        if (
+            not bad_frame
+            and version != b"HTTP/1.0"
+            and headers.get(b"expect", b"").lower() == b"100-continue"
+        ):
+            # answer the interim reply like the stock handler
+            # (http.server handle_expect_100): without it curl holds a
+            # large /solve_batch body back for its ~1 s Expect timeout
+            # before sending (ROADMAP fastserve-hardening (b)). Never
+            # for HTTP/1.0 requests (RFC 7231 §5.1.1: ignore Expect
+            # there — a 1.0 client would read the interim 100 as the
+            # final response), matching the stock handler's version gate.
+            conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
         if not bad_frame and content_length:
             body = rfile.read(content_length)
             if len(body) < content_length:
@@ -336,9 +366,7 @@ class FastHTTPServer:
     def _record(
         self, route: str, t0: float, error: bool = False, shed: bool = False
     ) -> None:
-        m = getattr(self.p2p_node, "metrics", None)
-        if m is not None:
-            m.record(route, time.perf_counter() - t0, error=error, shed=shed)
+        http_api.record_route(self.p2p_node, route, t0, error=error, shed=shed)
 
     # -- response ----------------------------------------------------------
     @staticmethod

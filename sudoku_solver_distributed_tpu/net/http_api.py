@@ -55,6 +55,18 @@ def _board_error(sudoku, size: int) -> str | None:
 # matter which transport carried the request.
 
 
+def record_route(
+    p2p_node, route: str, t0: float, error: bool = False, shed: bool = False
+) -> None:
+    """Fold one request into the node's RequestMetrics (when attached) —
+    the single definition both transports call (ROADMAP
+    fastserve-hardening (c); the stock handler and fastserve used to
+    carry byte-identical private copies)."""
+    m = getattr(p2p_node, "metrics", None)
+    if m is not None:
+        m.record(route, time.perf_counter() - t0, error=error, shed=shed)
+
+
 def _parse_deadline_ms(raw):
     """``X-Deadline-Ms`` header → float ms (relative latency budget), or
     None when absent/garbage. Garbage is treated as no header rather than
@@ -284,9 +296,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
     def _record(
         self, route: str, t0: float, error: bool = False, shed: bool = False
     ) -> None:
-        m = getattr(self.p2p_node, "metrics", None)
-        if m is not None:
-            m.record(route, time.perf_counter() - t0, error=error, shed=shed)
+        record_route(self.p2p_node, route, t0, error=error, shed=shed)
 
     def _read_body(self, route: str, t0: float, max_bytes=None):
         """Read the request body with keep-alive-safe framing. Returns the

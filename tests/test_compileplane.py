@@ -1,0 +1,252 @@
+"""Cold-start compiler plane (ISSUE 4): tiered/budgeted warmup, the
+persistent AOT artifact store, program-count collapse, warm-state
+observability, and the serve-before-fully-warm contract.
+
+All CPU, tier-1. The suite's shared persistent XLA cache (conftest env)
+keeps the repeated bucket compiles cheap; the AOT stores under test live
+in per-test tmp dirs so hit/miss/corruption scenarios are exact.
+"""
+
+import json
+import os
+import pickle
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from sudoku_solver_distributed_tpu import compilecache
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+
+
+def _aot_files(root):
+    aot = os.path.join(root, "aot")
+    if not os.path.isdir(aot):
+        return []
+    return sorted(
+        os.path.join(aot, f) for f in os.listdir(aot) if f.endswith(".aot")
+    )
+
+
+# -- program-count collapse --------------------------------------------------
+
+
+def test_program_collapse_one_program_per_bucket(readme_puzzle):
+    """The deep/quick variants share the bucket program (the iteration
+    budget is a traced argument): a fully-warm engine holds exactly
+    len(buckets) programs, and neither a deep retry nor a quick probe
+    adds one."""
+    eng = SolverEngine(buckets=(1, 8), coalesce=False)
+    eng.warmup()
+    assert eng.fully_warmed and eng.program_count() == 2
+    sol, _ = eng.solve_one(readme_puzzle)
+    assert sol is not None
+    # the quick probe at a different budget rides the SAME width-1 program
+    import jax
+
+    jax.block_until_ready(
+        eng._solve_quick(eng._device_batch(np.zeros((1, 9, 9), np.int32)))
+    )
+    assert eng.program_count() == 2
+
+
+def test_deep_retry_shares_the_bucket_program(readme_puzzle):
+    """An iteration-capped board triggers the deep safety net without
+    compiling a second program for the width."""
+    eng = SolverEngine(
+        buckets=(1,), max_iters=2, deep_retry_factor=2, coalesce=False
+    )
+    _, ok, info = eng.solve_batch_np(np.asarray(readme_puzzle)[None])
+    assert info["capped"] == 1 and not bool(ok.any())
+    assert eng.program_count() == 1
+
+
+# -- tiered warmup + budget --------------------------------------------------
+
+
+def test_tiered_warmup_order_and_signals():
+    """Tier 0 (smallest + coalescer-preferred buckets) compiles first and
+    flips `warmed`; `fully_warmed` needs the whole ladder. A bare
+    warmup() still returns fully warm (the pre-ISSUE-4 contract)."""
+    eng = SolverEngine(buckets=(1, 8, 64), coalesce_max_batch=8)
+    assert not eng.warmed and not eng.fully_warmed
+    eng.warmup()
+    info = eng.warm_info()
+    assert info["tier0"] == [1, 8]
+    # tier-0 buckets compiled before the widening's remainder
+    assert info["order"][:2] == [1, 8] and set(info["order"]) == {1, 8, 64}
+    assert eng.warmed and eng.fully_warmed and not info["skipped"]
+    eng.close()
+
+
+def test_warmup_budget_cuts_widening_and_serving_tiles():
+    """budget_s=0: tier 0 still compiles (budget-exempt, serving must
+    flip warm), the wide rungs are skipped, and an oversize batch tiles
+    over the warm widths instead of compiling a cold bucket."""
+    eng = SolverEngine(buckets=(1, 8, 64), coalesce=False)
+    eng.warmup(budget_s=0.0)
+    info = eng.warm_info()
+    assert eng.warmed and not eng.fully_warmed
+    assert info["buckets"]["1"]["warm"] and not info["buckets"]["64"]["warm"]
+    assert info["skipped"] == [8, 64]
+    boards = np.zeros((16, 9, 9), np.int32)
+    _, ok, _ = eng.solve_batch_np(boards)
+    assert bool(ok.all())
+    # tiled over width 1 — no 8- or 64-wide program was compiled
+    assert eng.program_count() == 1
+    # a later un-budgeted warmup resumes where the cut left off
+    eng.warmup()
+    assert eng.fully_warmed and eng.warm_info()["skipped"] == []
+
+
+def test_background_warmup_serves_before_fully_warm(readme_puzzle):
+    """warmup(background=True) returns at tier-0 warm; a solve succeeds
+    while (or regardless of whether) the ladder still widens behind it."""
+    eng = SolverEngine(buckets=(1, 8), coalesce=False)
+    eng.warmup(background=True)
+    assert eng.warmed  # tier 0 compiled synchronously
+    sol, _ = eng.solve_one(readme_puzzle)
+    assert sol is not None and oracle_is_valid_solution(sol)
+    deadline = time.time() + 120
+    while not eng.fully_warmed and time.time() < deadline:
+        time.sleep(0.02)
+    assert eng.fully_warmed
+
+
+# -- AOT artifact store ------------------------------------------------------
+
+
+def test_aot_cache_miss_then_hit(tmp_path, readme_puzzle):
+    """First engine bakes (compile+save), second loads the verified
+    artifact and solves correctly."""
+    plane = str(tmp_path / "plane")
+    e1 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    e1.warmup()
+    i1 = e1.warm_info()
+    assert i1["buckets"]["1"]["source"] == "compile+save"
+    assert i1["aot"]["saved"] == 1 and len(_aot_files(plane)) == 1
+    e2 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    e2.warmup()
+    i2 = e2.warm_info()
+    assert i2["buckets"]["1"]["source"].startswith("aot:")
+    assert i2["aot"]["loaded"] >= 1 and i2["aot"]["errors"] == 0
+    sol, _ = e2.solve_one(readme_puzzle)
+    assert sol is not None and oracle_is_valid_solution(sol)
+
+
+def test_aot_corrupt_artifact_falls_back_to_compile(tmp_path):
+    """Garbage bytes in the artifact: load fails, the file is deleted,
+    warmup falls back to compiling — never an error to the caller."""
+    plane = str(tmp_path / "plane")
+    e1 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    e1.warmup()
+    (path,) = _aot_files(plane)
+    with open(path, "wb") as f:
+        f.write(b"not a pickle at all")
+    e2 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    e2.warmup()
+    i2 = e2.warm_info()
+    assert i2["buckets"]["1"]["warm"]
+    assert i2["buckets"]["1"]["source"] == "compile+save"  # re-baked
+    assert i2["aot"]["errors"] >= 1
+
+
+def test_aot_fingerprint_mismatch_falls_back_to_jit(tmp_path):
+    """An artifact stamped by a different backend (jax upgrade, other
+    device kind) must not load — warmup recompiles; the foreign file is
+    left in place for the backend that owns it."""
+    plane = str(tmp_path / "plane")
+    e1 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    e1.warmup()
+    (path,) = _aot_files(plane)
+    with open(path, "rb") as f:
+        record = pickle.load(f)
+    record["fingerprint"] = "jax=9.9.9;platform=tpu;kind=v9;n=4096;format=1"
+    with open(path, "wb") as f:
+        pickle.dump(record, f)
+    e2 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    e2.warmup()
+    i2 = e2.warm_info()
+    assert i2["buckets"]["1"]["warm"]
+    assert i2["buckets"]["1"]["source"] == "compile+save"
+    assert i2["aot"]["errors"] >= 1
+    assert os.path.exists(path) or _aot_files(plane)  # re-baked under the key
+
+
+def test_aot_verification_gates_wrong_artifact(tmp_path, monkeypatch):
+    """An artifact that deserializes but solves WRONG is rejected by the
+    round-trip verification and deleted."""
+    plane = str(tmp_path / "plane")
+    e1 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    e1.warmup()
+    e2 = SolverEngine(buckets=(1,), compile_cache_dir=plane, coalesce=False)
+    monkeypatch.setattr(
+        SolverEngine, "_verify_aot", lambda self, exe, b: False
+    )
+    e2.warmup()
+    assert e2.warm_info()["buckets"]["1"]["source"] in (
+        "compile+save",  # re-bake also re-verifies (still mocked False)
+        "jit",
+    )
+    # the poisoned artifact did not survive to serve
+    assert e2.warm_info()["buckets"]["1"]["source"] != "aot:exec"
+
+
+def test_enable_persistent_cache_first_wins(tmp_path):
+    """The suite's conftest already configured a cache dir — an engine's
+    compile_cache_dir must keep it (never silently re-point an
+    established cache) and still run its AOT store."""
+    import jax
+
+    before = jax.config.jax_compilation_cache_dir
+    assert before  # conftest set one
+    assert not compilecache.enable_persistent_cache(str(tmp_path / "xla"))
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+# -- warm state on the serving surface --------------------------------------
+
+
+def test_metrics_warm_state_and_solve_before_fully_warm(readme_puzzle):
+    """End to end over HTTP: a node whose warmup budget cut the ladder
+    serves a correct /solve while /metrics reports tier-0 warm but not
+    fully warm, with per-bucket detail."""
+    from test_net_node import free_port
+    from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+    from sudoku_solver_distributed_tpu.net.node import P2PNode
+
+    eng = SolverEngine(buckets=(1, 8, 64), coalesce=False)
+    eng.warmup(budget_s=0.0)
+    node = P2PNode("127.0.0.1", free_port(), engine=eng)
+    threading.Thread(target=node.run, daemon=True).start()
+    httpd = make_http_server(
+        node, "127.0.0.1", 0, expose_metrics=True
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    try:
+        body = json.dumps({"sudoku": readme_puzzle}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/solve",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            solution = json.loads(resp.read())
+        assert oracle_is_valid_solution(solution)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ) as resp:
+            metrics = json.loads(resp.read())
+        engine_m = metrics["engine"]
+        assert engine_m["warmed"] and not engine_m["fully_warmed"]
+        warm = engine_m["warm"]
+        assert warm["buckets"]["1"]["warm"]
+        assert not warm["buckets"]["64"]["warm"]
+        assert warm["skipped"] == [8, 64]
+        assert warm["programs"] >= 1
+    finally:
+        httpd.shutdown()
+        node.shutdown()

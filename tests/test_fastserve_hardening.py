@@ -1,0 +1,176 @@
+"""fastserve hardening (ROADMAP items (a)-(c), PR 4 satellites): the
+worker pool survives route-core crashes, Expect: 100-continue gets its
+interim reply, and both transports share one metrics-record helper."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+from sudoku_solver_distributed_tpu.net import http_api
+from sudoku_solver_distributed_tpu.net.fastserve import FastHTTPServer
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from test_net_node import free_port
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1,), coalesce=False)
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture
+def server(engine):
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    threading.Thread(target=node.run, daemon=True).start()
+    httpd = FastHTTPServer(node, "127.0.0.1", 0, expose_batch=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield httpd
+    httpd.shutdown()
+    node.shutdown()
+
+
+def _post(port, path, body: bytes, extra_headers=b"", timeout=60.0):
+    """Raw-socket POST; returns every byte the server sent (so interim
+    1xx replies are visible, unlike urllib)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(
+            b"POST %s HTTP/1.1\r\nHost: x\r\nContent-Length: %d\r\n"
+            b"%sConnection: close\r\n\r\n" % (path, len(body), extra_headers)
+        )
+        s.sendall(body)
+        chunks = []
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks.append(b)
+        return b"".join(chunks)
+    finally:
+        s.close()
+
+
+def test_worker_pool_recovers_from_route_core_crash(
+    server, monkeypatch, readme_puzzle
+):
+    """A route core raising outside (OSError, ValueError) used to kill
+    the worker thread with `_workers` never decremented — repeated
+    faults could wedge the pool for good (ROADMAP fastserve-hardening
+    (a)). Now the worker logs, drops the connection, and keeps serving."""
+    port = server.server_address[1]
+    body = json.dumps({"sudoku": readme_puzzle}).encode()
+
+    real = http_api.solve_route
+    crashes = {"n": 0}
+
+    def crashing(node, raw, deadline_ms=None):
+        crashes["n"] += 1
+        raise RuntimeError("injected route-core fault")
+
+    monkeypatch.setattr(http_api, "solve_route", crashing)
+    # several faulting requests — more than one so a die-per-fault bug
+    # would visibly shrink the pool
+    for _ in range(3):
+        raw = _post(port, b"/solve", body, timeout=10.0)
+        assert raw == b""  # connection dropped, nothing half-written
+    assert crashes["n"] == 3
+    monkeypatch.setattr(http_api, "solve_route", real)
+
+    # the pool recovered: the next request is served normally
+    raw = _post(port, b"/solve", body)
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200")
+    assert oracle_is_valid_solution(json.loads(payload))
+    # worker accounting stayed consistent (finally-decrement + catch-all:
+    # live workers never exceed the spawn count and the pool is not empty)
+    with server._pool_lock:
+        assert 0 < server._workers <= server.max_workers
+
+
+def test_expect_100_continue_gets_interim_reply(server, readme_puzzle):
+    """A client sending Expect: 100-continue must see `100 Continue`
+    before the final status — without it curl holds large /solve_batch
+    bodies back ~1 s (ROADMAP fastserve-hardening (b))."""
+    port = server.server_address[1]
+    body = json.dumps({"sudokus": [readme_puzzle]}).encode()
+    s = socket.create_connection(("127.0.0.1", port), timeout=60.0)
+    try:
+        s.sendall(
+            b"POST /solve_batch HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: %d\r\nExpect: 100-continue\r\n"
+            b"Connection: close\r\n\r\n" % len(body)
+        )
+        # the interim reply must arrive BEFORE the body is sent
+        s.settimeout(10.0)
+        interim = s.recv(4096)
+        assert interim.startswith(b"HTTP/1.1 100 Continue\r\n")
+        s.sendall(body)
+        chunks = [interim[len(b"HTTP/1.1 100 Continue\r\n\r\n"):]]
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks.append(b)
+        raw = b"".join(chunks)
+    finally:
+        s.close()
+    assert b"HTTP/1.1 200" in raw
+    payload = json.loads(raw.partition(b"\r\n\r\n")[2])
+    assert payload["solved"] == 1
+
+
+def test_expect_ignored_on_http_1_0(server, readme_puzzle):
+    """RFC 7231 §5.1.1: Expect on an HTTP/1.0 request is ignored — a 1.0
+    client would read the interim 100 as its final response. Matches the
+    stock handler's version gate."""
+    port = server.server_address[1]
+    body = json.dumps({"sudokus": [readme_puzzle]}).encode()
+    s = socket.create_connection(("127.0.0.1", port), timeout=60.0)
+    try:
+        s.sendall(
+            b"POST /solve_batch HTTP/1.0\r\nHost: x\r\n"
+            b"Content-Length: %d\r\nExpect: 100-continue\r\n\r\n"
+            % len(body)
+        )
+        s.sendall(body)
+        chunks = []
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks.append(b)
+        raw = b"".join(chunks)
+    finally:
+        s.close()
+    assert not raw.startswith(b"HTTP/1.1 100")
+    assert raw.startswith(b"HTTP/1.1 200")
+    assert json.loads(raw.partition(b"\r\n\r\n")[2])["solved"] == 1
+
+
+def test_record_route_shared_by_both_transports(engine):
+    """One definition (http_api.record_route) feeds RequestMetrics for
+    both transports (ROADMAP fastserve-hardening (c))."""
+    from sudoku_solver_distributed_tpu.utils.profiling import RequestMetrics
+
+    node = P2PNode(
+        "127.0.0.1", free_port(), engine=engine, metrics=RequestMetrics()
+    )
+    t0 = time.perf_counter()
+    http_api.record_route(node, "/solve", t0)
+    http_api.record_route(node, "/solve", t0, error=True)
+    summary = node.metrics.summary()
+    assert summary["/solve"]["count"] == 2
+    assert summary["/solve"]["errors"] == 1
+    # both transports' _record delegate here (no byte-identical copies)
+    import inspect
+
+    from sudoku_solver_distributed_tpu.net.http_api import SudokuHTTPHandler
+
+    assert "record_route" in inspect.getsource(FastHTTPServer._record)
+    assert "record_route" in inspect.getsource(SudokuHTTPHandler._record)
