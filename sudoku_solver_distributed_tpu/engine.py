@@ -29,6 +29,7 @@ import logging
 import os
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -336,6 +337,19 @@ class SolverEngine:
         self.coalesce_adaptive = coalesce_adaptive
         self._coalescer = None
         self._coalescer_init_lock = threading.Lock()
+        # Failure-domain supervision (ISSUE 5, serving/health.py): when an
+        # EngineSupervisor is attached it opens a watchdog token around
+        # every bucket-path device call (_dispatch_padded/_finalize_padded),
+        # bucket selection routes around quarantined widths, and the
+        # single-board serving path reroutes through the host-oracle
+        # fallback while the breaker is open. None (default): zero cost,
+        # byte-identical behavior.
+        self.supervisor = None
+        # engine-seam chaos hook (utils/faults.EngineFaultInjector): when
+        # set, every bucket dispatch/fetch passes through it — fail-next-N,
+        # injected latency (watchdog food), bucket poisoning. None costs
+        # nothing; counters surface under /metrics "faults".
+        self.fault_injector = None
         # Warm-state plane (ISSUE 4). `warmed` flips at TIER-0 warm — the
         # smallest serving bucket (+ the coalescer's preferred bucket and
         # the probe program) compiled, i.e. /solve is servable without
@@ -542,10 +556,13 @@ class SolverEngine:
         return self._coalescer
 
     def close(self) -> None:
-        """Drain and stop the coalescer (futures resolve before return).
-        Safe to call on an engine that never coalesced; idempotent."""
+        """Drain and stop the coalescer (futures resolve before return)
+        and the supervisor's watchdog when one is attached. Safe to call
+        on an engine that never coalesced; idempotent."""
         if self._coalescer is not None:
             self._coalescer.close()
+        if self.supervisor is not None:
+            self.supervisor.close()
 
     def health(self) -> dict:
         """Operator-facing engine health, served under /metrics "engine".
@@ -567,6 +584,10 @@ class SolverEngine:
             "fully_warmed": self.fully_warmed,
             "warm": self.warm_info(),
         }
+        if self.supervisor is not None:
+            # the one-word summary; the full state machine lives in the
+            # /metrics top-level "health" block (supervisor.snapshot())
+            out["supervisor"] = self.supervisor.state
         if self._coalescer is not None:
             out["coalescer"] = self._coalescer.stats()
         loop = self.frontier_loop
@@ -656,14 +677,27 @@ class SolverEngine:
             )
 
     def _bucket_for(self, n: int) -> int:
+        # widths the supervisor quarantined (hung/failed programs) are
+        # routed around — the next covering width serves instead; if
+        # EVERY covering width is quarantined the original choice stands
+        # (the caller's failure handling / fallback is the backstop, and
+        # refusing to pick a bucket would be a new failure mode)
+        quarantined = (
+            self.supervisor.quarantined_widths()
+            if self.supervisor is not None
+            else ()
+        )
         if self._tiling_active():
             warm = self._warm_widths()
             for b in warm:
-                if n <= b:
+                if n <= b and b not in quarantined:
                     return b
             # wider than every warm width: fall through to the cold
             # ladder (a direct dispatch can't tile — solve_batch_np
             # bounds its chunks by the largest warm width instead)
+        for b in self.buckets:
+            if n <= b and b not in quarantined:
+                return b
         for b in self.buckets:
             if n <= b:
                 return b
@@ -682,9 +716,29 @@ class SolverEngine:
         device call is async-dispatched: this returns as soon as the program
         is enqueued, so a caller (the coalescer's dispatcher thread) can
         encode/pad batch N+1 on the host while batch N runs on device.
+
+        THE supervised seam (serving/health.py): a watchdog token opens
+        here and closes in ``_finalize_padded``, so the supervisor bounds
+        the wall time of the whole dispatch→fetch span — and the
+        engine-seam fault injector (utils/faults.EngineFaultInjector)
+        plugs in at the same two points.
         """
         n = boards.shape[0]
         bucket = self._bucket_for(n)
+        sup = self.supervisor
+        token = sup.call_started(bucket) if sup is not None else None
+        try:
+            return (*self._dispatch_padded_inner(boards, bucket), token)
+        except BaseException:
+            if sup is not None:
+                sup.call_finished(token, ok=False)
+            raise
+
+    def _dispatch_padded_inner(self, boards: np.ndarray, bucket: int):
+        n = boards.shape[0]
+        inj = self.fault_injector
+        if inj is not None:
+            inj.on_device_call(bucket)  # may raise (fail-next-N)
         if n < bucket:
             # Pad with a COPY of a real row, not empty boards: the lockstep
             # kernel runs until the slowest board in the bucket finishes,
@@ -711,13 +765,34 @@ class SolverEngine:
             packed = self._solve(self._device_batch(boards))
         return packed, boards, n
 
-    def _finalize_padded(self, packed, boards: np.ndarray, n: int) -> np.ndarray:
+    def _finalize_padded(
+        self, packed, boards: np.ndarray, n: int, token=None
+    ) -> np.ndarray:
         """Fetch an in-flight ``_dispatch_padded`` call (blocks on the
         device) and run the deep-retry safety net on any capped rows.
+        ``token`` is the supervision token the dispatch opened (rides the
+        opaque handle; closed here however the fetch ends).
 
         Returns the packed (n, C+4) host array: [grid | solved | status |
         guesses | validations] per row.
         """
+        sup = self.supervisor
+        try:
+            rows = self._finalize_padded_inner(packed, boards, n)
+        except BaseException:
+            if sup is not None:
+                sup.call_finished(token, ok=False)
+            raise
+        if sup is not None:
+            sup.call_finished(token, ok=True)
+        return rows
+
+    def _finalize_padded_inner(
+        self, packed, boards: np.ndarray, n: int
+    ) -> np.ndarray:
+        inj = self.fault_injector
+        if inj is not None:
+            inj.on_fetch(boards.shape[0])  # may sleep (watchdog food)
         # THE documented sync point of the bucket path: exactly one
         # device→host transfer per dispatched batch, made explicit with
         # block_until_ready (analysis/jax_hygiene.py JAX101 contract).
@@ -725,6 +800,8 @@ class SolverEngine:
         # view of the device buffer, and the deep-retry merge below
         # writes into the capped rows
         packed = np.array(jax.block_until_ready(packed))
+        if inj is not None:
+            packed = inj.corrupt(boards.shape[0], packed)
         C = self.spec.cells
         running = packed[:, C + 1] == RUNNING
         # trigger on REAL rows only: a deep pass for discarded pad lanes is
@@ -1430,11 +1507,90 @@ class SolverEngine:
                     self.frontier_fallbacks += 1
         return self._solve_one_bucket(arr)
 
+    def _await_result(self, fut):
+        """``fut.result()`` — BOUNDED when a supervisor is attached: a
+        truly hung device call blocks the coalescer's completion thread
+        forever, and an untimed wait would pin this handler thread (and
+        with it a bounded-pool transport worker) just as permanently. The
+        bound is past the watchdog's hang declaration by construction, so
+        a trip has already rerouted serving when it fires; the starved
+        future is cancelled (the completer's ``done()`` guard then skips
+        it) and the raise sends THIS request to the fallback."""
+        sup = self.supervisor
+        if sup is None:
+            return fut.result()
+        timeout = 2.0 * sup.watchdog_budget_s + 5.0
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeout:
+            fut.cancel()
+            raise RuntimeError(
+                f"supervised solve starved past {timeout:.1f}s "
+                "(hung device call ahead of it?)"
+            ) from None
+
+    def _supervised_answer(self, sup, arr: np.ndarray, call, deadline_s=None):
+        """THE degraded-serving contract, in one place (applied by
+        ``_solve_one_bucket`` and ``solve_one_supervised``): an open
+        breaker answers from the host-oracle fallback before the device
+        is touched (the entry fallback honors ``deadline_s`` while
+        queued on the fallback semaphore — queue wait only, like the
+        coalescer); a device failure mid-call falls back instead of
+        erroring the request (the seam already fed the breaker; service
+        time was paid, so no deadline re-check); and every device answer
+        is verified host-side so a poisoned program can never emit a
+        silent wrong answer — a corrupted grid OR a false UNSAT claim.
+        ``DeadlineExceeded`` always propagates — a shed request must
+        stay shed."""
+        from .serving.admission import DeadlineExceeded
+
+        if sup.should_fallback():
+            return sup.fallback_solve(arr, deadline_s=deadline_s)
+        try:
+            solution, info = call()
+        except DeadlineExceeded:
+            raise
+        except Exception:
+            logger.exception(
+                "device path failed — answering from the host-oracle "
+                "fallback"
+            )
+            return sup.fallback_solve(arr)
+        if solution is not None and not sup.check_solution(arr, solution):
+            # device call "succeeded" but the answer is wrong: the
+            # poisoned-program failure mode — never serve it
+            logger.error(
+                "device answer failed host-side verification — "
+                "poisoned program? answering from the fallback"
+            )
+            sup.record_failure(None, "bad-result")
+            return sup.fallback_solve(arr)
+        if solution is None and not info.get("capped"):
+            # device claims PROVEN unsatisfiable (capped answers claim
+            # only "not finished" and are exempt): cross-check — a
+            # poisoned program clearing the solved flag is as wrong as
+            # one corrupting the grid, and must trip the breaker too
+            alt, alt_info = sup.verify_unsat(arr)
+            if alt is not None:
+                sup.record_failure(None, "bad-result")
+                return alt, alt_info
+        return solution, info
+
     def _solve_one_bucket(self, arr: np.ndarray):
         """Single-board bucket path: coalesced with concurrent requests
-        when enabled (parallel/coalescer.py), else a direct batch-1 call."""
+        when enabled (parallel/coalescer.py), else a direct batch-1 call.
+        With a supervisor attached this is the degraded-mode seam
+        (``_supervised_answer``)."""
+        sup = self.supervisor
+        if sup is None:
+            return self._solve_one_bucket_direct(arr)
+        return self._supervised_answer(
+            sup, arr, lambda: self._solve_one_bucket_direct(arr)
+        )
+
+    def _solve_one_bucket_direct(self, arr: np.ndarray):
         if self.coalesce:
-            solution, info = self.coalescer.solve(arr)
+            solution, info = self._await_result(self.coalescer.submit(arr))
         else:
             solutions, solved_mask, info = self.solve_batch_np(arr[None])
             solution = solutions[0].tolist() if solved_mask[0] else None
@@ -1494,3 +1650,56 @@ class SolverEngine:
         except BaseException as e:  # noqa: BLE001 — deliver through the future
             fut.set_exception(e)
         return fut
+
+    def solve_one_supervised(
+        self,
+        board: Sequence[Sequence[int]],
+        *,
+        deadline_s: Optional[float] = None,
+    ) -> Tuple[Optional[List[List[int]]], dict]:
+        """``solve_one_async(...).result()`` with the supervisor's
+        degraded-serving contract applied in the CALLING thread — the
+        serving entry point ``net/node.py`` uses for /solve requests.
+
+        Without a supervisor this is exactly the await the node used to
+        do. With one, the ``_supervised_answer`` contract applies (open
+        breaker → bounded host-oracle fallback; device failure OR a
+        starved future — a hung batch ahead of this request — falls back
+        instead of erroring or pinning the handler thread; answers are
+        verified host-side). Deadline semantics are preserved:
+        ``DeadlineExceeded`` always propagates (a shed request must stay
+        shed — the 429 path), and the fallback honors an already-expired
+        deadline the same way the inline path does. The fallback work
+        runs HERE, in the handler's thread, never in the coalescer's
+        completion thread. Inline routes (frontier engines,
+        ``coalesce=False``) supervise inside ``_solve_one_bucket`` — one
+        contract implementation, applied once per request."""
+        sup = self.supervisor
+        if sup is None:
+            return self.solve_one_async(board, deadline_s=deadline_s).result()
+        from .serving.admission import DeadlineExceeded
+
+        arr = np.asarray(board, np.int32)
+        if sup.should_fallback() and (
+            deadline_s is not None and time.monotonic() > deadline_s
+        ):
+            raise DeadlineExceeded(
+                "deadline expired before the solve started"
+            )
+        if self.coalesce and not self.frontier_enabled:
+            return self._supervised_answer(
+                sup,
+                arr,
+                lambda: self._await_result(
+                    self.coalescer.submit(arr, deadline_s)
+                ),
+                deadline_s=deadline_s,
+            )
+        # inline paths run in this thread anyway; solve_one supervises
+        # them in _solve_one_bucket (a failed frontier race already
+        # downgrades there) — wrapping again here would just re-verify
+        if deadline_s is not None and time.monotonic() > deadline_s:
+            raise DeadlineExceeded(
+                "deadline expired before the solve started"
+            )
+        return self.solve_one(board)

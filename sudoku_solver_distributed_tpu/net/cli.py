@@ -170,6 +170,48 @@ def build_parser() -> argparse.ArgumentParser:
         "dropped before the device runs them. 0 (default) = no deadline",
     )
     parser.add_argument(
+        "--supervise-engine",
+        action="store_true",
+        help="failure-domain supervision for the engine/device plane "
+        "(serving/health.py): a watchdog bounds device-call wall time, a "
+        "circuit breaker drives WARMING/HEALTHY/DEGRADED/LOST, "
+        "DEGRADED/LOST serve correct answers from a bounded host-oracle "
+        "fallback (X-Degraded header) while half-open probes — verified "
+        "round-trip solves — re-admit the device. Off by default: no "
+        "supervision, byte-identical serving",
+    )
+    parser.add_argument(
+        "--watchdog-budget-s",
+        type=float,
+        default=30.0,
+        help="with --supervise-engine: wall-time budget per device call "
+        "before it is declared hung (bucket quarantined, breaker fed)",
+    )
+    parser.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=3,
+        help="with --supervise-engine: consecutive failures before "
+        "DEGRADED escalates to LOST (engine rebuild + probe-gated "
+        "re-admission)",
+    )
+    parser.add_argument(
+        "--probe-interval-s",
+        type=float,
+        default=2.0,
+        help="with --supervise-engine: half-open probe cadence while the "
+        "breaker is open",
+    )
+    parser.add_argument(
+        "--fallback-concurrency",
+        type=int,
+        default=2,
+        help="with --supervise-engine: max concurrent host-oracle "
+        "fallback solves while DEGRADED/LOST (bounded — the fallback "
+        "keeps the node answering, it does not pretend the host is a "
+        "TPU)",
+    )
+    parser.add_argument(
         "--http-workers",
         type=int,
         default=128,
@@ -366,6 +408,23 @@ def main(argv=None) -> None:
             capacity=args.admission_capacity,
             default_deadline_ms=args.default_deadline_ms,
         )
+    if args.supervise_engine:
+        from ..serving.health import EngineSupervisor
+
+        supervisor = EngineSupervisor(
+            engine,
+            watchdog_budget_s=args.watchdog_budget_s,
+            breaker_threshold=args.breaker_threshold,
+            probe_interval_s=args.probe_interval_s,
+            fallback_concurrency=args.fallback_concurrency,
+        )
+        if admission is not None:
+            # every regime change — device lost AND device re-admitted —
+            # re-anchors the capacity estimator on the throughput the
+            # node can actually deliver NOW (serving/admission.py)
+            supervisor.add_transition_callback(
+                lambda _old, _new: admission.reanchor()
+            )
     node = P2PNode(
         args.host,
         args.s,

@@ -49,6 +49,7 @@ _REASONS = {
     400: b"Bad Request",
     404: b"Not Found",
     429: b"Too Many Requests",
+    503: b"Service Unavailable",
 }
 # generous cap for any route; /solve_batch's documented bound (http_api)
 _MAX_BODY = http_api.MAX_BATCH_BYTES
@@ -310,7 +311,7 @@ class FastHTTPServer:
             self._reply(conn, 400, {"error": "Invalid request"}, close=True)
             return False
 
-        status, payload, close_after = self._route(
+        status, payload, close_after, degraded = self._route(
             method,
             path.decode("latin-1"),
             body,
@@ -319,7 +320,10 @@ class FastHTTPServer:
                 headers.get(b"x-deadline-ms")
             ),
         )
-        self._reply(conn, status, payload, close=close or close_after)
+        self._reply(
+            conn, status, payload, close=close or close_after,
+            degraded=degraded,
+        )
         return not (close or close_after)
 
     # -- routing -----------------------------------------------------------
@@ -327,41 +331,49 @@ class FastHTTPServer:
         self, method: bytes, path: str, body: bytes, t0: float,
         deadline_ms=None,
     ):
-        """Returns (status, payload, close_after). Bodies come from the
-        shared route cores — byte-identical to the stock transport."""
+        """Returns (status, payload, close_after, degraded). Bodies come
+        from the shared route cores — byte-identical to the stock
+        transport; ``degraded`` marks fallback-served /solve answers
+        (the X-Degraded header)."""
         node = self.p2p_node
         if method == b"POST":
             if path == "/solve":
-                status, payload, error = http_api.solve_route(
+                status, payload, error, degraded = http_api.solve_route(
                     node, body, deadline_ms=deadline_ms
                 )
                 shed = status == 429
                 self._record(
                     "/solve", t0, error=error and not shed, shed=shed
                 )
-                return status, payload, False
+                return status, payload, False, degraded
             if path == "/solve_batch" and self.expose_batch:
                 status, payload, error = http_api.solve_batch_route(
                     node, body
                 )
                 self._record("/solve_batch", t0, error=error)
-                return status, payload, False
+                return status, payload, False, False
             # unknown POST path: the stock handler never reads these
             # bodies and must close; this transport already consumed the
             # body, but it keeps the same observable contract
-            return 404, {"error": "Invalid endpoint"}, True
+            return 404, {"error": "Invalid endpoint"}, True, False
         if method == b"GET":
             if path == "/stats":
                 return (
                     200,
                     http_api.stats_payload(node, self.expose_serving),
                     False,
+                    False,
                 )
             if path == "/network":
-                return 200, node.network_view(), False
+                return 200, node.network_view(), False, False
             if path == "/metrics" and self.expose_metrics:
-                return 200, http_api.metrics_payload(node), False
-        return 404, {"error": "Invalid endpoint"}, False
+                return 200, http_api.metrics_payload(node), False, False
+            if path == "/healthz":
+                return 200, http_api.healthz_payload(node), False, False
+            if path == "/readyz":
+                status, payload = http_api.readyz_route(node)
+                return status, payload, False, False
+        return 404, {"error": "Invalid endpoint"}, False, False
 
     def _record(
         self, route: str, t0: float, error: bool = False, shed: bool = False
@@ -370,9 +382,15 @@ class FastHTTPServer:
 
     # -- response ----------------------------------------------------------
     @staticmethod
-    def _reply(conn, status: int, payload, *, close: bool) -> None:
+    def _reply(
+        conn, status: int, payload, *, close: bool, degraded: bool = False
+    ) -> None:
         body = json.dumps(payload).encode()
         extra = b"Connection: close\r\n" if close else b""
+        if degraded:
+            # fallback-served answer marker; body stays byte-identical
+            # (see http_api.SudokuHTTPHandler._send_response)
+            extra = b"X-Degraded: true\r\n" + extra
         if status == 429:
             retry = http_api.retry_after_header(payload)
             if retry is not None:

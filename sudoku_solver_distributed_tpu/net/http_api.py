@@ -105,6 +105,11 @@ def retry_after_header(payload) -> str | None:
 def solve_route(p2p_node, body: bytes, deadline_ms=None):
     """POST /solve: the reference's solve surface (node.py:661-690).
 
+    Returns ``(status, payload, error_flag, degraded)`` — ``degraded``
+    True when the answer came from the supervisor's host-oracle fallback
+    (serving/health.py); transports surface it as the ``X-Degraded``
+    response header, keeping the BODY byte-identical to the reference.
+
     ``deadline_ms`` is the request's relative latency budget (the
     ``X-Deadline-Ms`` header, parsed by the transport). With an admission
     controller attached to the node (serving/admission.py; off by
@@ -124,6 +129,7 @@ def solve_route(p2p_node, body: bytes, deadline_ms=None):
             429,
             _shed_payload("Overloaded", decision.retry_after_s),
             True,
+            False,
         )
     from ..serving.admission import DeadlineExceeded
 
@@ -139,6 +145,7 @@ def solve_route(p2p_node, body: bytes, deadline_ms=None):
             429,
             _shed_payload("Deadline exceeded", adm.retry_hint_s()),
             True,
+            False,
         )
     finally:
         # served=False (a body rejected before the engine ran) must not
@@ -161,18 +168,26 @@ def _solve_core(p2p_node, body: bytes, deadline_s, outcome=None):
         # TypeError: a JSON-valid non-object body ([1,2,3], "foo") makes
         # body["sudoku"] a non-subscript access — same 400, never a dead
         # handler thread (code-review r5)
-        return 400, {"error": "Invalid request"}, True
+        return 400, {"error": "Invalid request"}, True, False
     reason = _board_error(sudoku, p2p_node.engine.spec.size)
     if reason is not None:
         logger.info("rejected /solve body: %s", reason)
-        return 400, {"error": "Invalid request"}, True
+        return 400, {"error": "Invalid request"}, True, False
     if outcome is not None:
         outcome["served"] = True  # past validation: the engine runs now
-    solution = p2p_node.peer_sudoku_solve(sudoku, deadline_s=deadline_s)
+    solution, info = p2p_node.peer_sudoku_solve_info(
+        sudoku, deadline_s=deadline_s
+    )
+    degraded = bool(info.get("degraded"))
     logger.debug("execution time: %s", time.time() - t_in)
     if solution:
-        return 200, solution, False
-    return 400, {"error": "No solution found", "solution": solution}, True
+        return 200, solution, False, degraded
+    return (
+        400,
+        {"error": "No solution found", "solution": solution},
+        True,
+        degraded,
+    )
 
 
 def solve_batch_route(p2p_node, body: bytes):
@@ -210,6 +225,38 @@ def solve_batch_route(p2p_node, body: bytes):
         },
         False,
     )
+
+
+def healthz_payload(p2p_node):
+    """GET /healthz — liveness. 200 the moment the HTTP plane answers:
+    a live process that is DEGRADED or even LOST must NOT be restarted
+    by its orchestrator (it is still answering correctly from the
+    fallback); that distinction is exactly what /readyz carries."""
+    return {"ok": True}
+
+
+def readyz_route(p2p_node):
+    """GET /readyz — readiness, returns (status, payload): 200 when this
+    node should receive traffic (engine tier-0 ``warmed`` AND the
+    supervisor — when one is attached — is not LOST), else 503 so an
+    orchestrator gates traffic away while the node cold-starts or
+    rebuilds a lost engine. DEGRADED stays ready on purpose: the
+    host-oracle fallback serves correct answers, and pulling the node
+    would turn a slow-but-correct replica into lost capacity.
+
+    Both transports serve this byte-identically (shared core, like every
+    other route); unlike /metrics these two routes are unconditional —
+    an orchestrator's probe config cannot depend on app flags.
+    """
+    eng = getattr(p2p_node, "engine", None)
+    warmed = bool(getattr(eng, "warmed", False))
+    sup = getattr(eng, "supervisor", None)
+    lost = bool(sup is not None and sup.is_lost)
+    ready = warmed and not lost
+    body = {"ready": ready, "warmed": warmed}
+    if sup is not None:
+        body["health"] = sup.state
+    return (200 if ready else 503), body
 
 
 def stats_payload(p2p_node, expose_serving: bool):
@@ -250,6 +297,29 @@ def metrics_payload(p2p_node):
         # in adaptive mode — the current max-wait ride under
         # "engine"/"coalescer" above
         body["admission"] = adm.snapshot()
+    sup = getattr(eng, "supervisor", None)
+    if sup is not None:
+        # the failure-domain supervision plane (serving/health.py):
+        # state machine, breaker, quarantine, fallback/probe counters —
+        # plus the gossip-carried view of PEER supervisor states the
+        # task farm routes around (net/stats.PeerHealth)
+        health = sup.snapshot()
+        peers = getattr(p2p_node, "peer_health", None)
+        if peers is not None:
+            health["peers"] = peers.snapshot()
+        body["health"] = health
+    # armed chaos injectors (utils/faults.py): their counters belong on
+    # the observability surface — a chaos run must be readable from
+    # /metrics, not from log scraping
+    faults = {}
+    wire_inj = getattr(p2p_node, "fault_injector", None)
+    if wire_inj is not None:
+        faults["wire"] = wire_inj.counts()
+    eng_inj = getattr(eng, "fault_injector", None)
+    if eng_inj is not None:
+        faults["engine"] = eng_inj.counts()
+    if faults:
+        body["faults"] = faults
     return body
 
 
@@ -275,11 +345,19 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
     MAX_BATCH = MAX_BATCH
     MAX_BATCH_BYTES = MAX_BATCH_BYTES
 
-    def _send_response(self, content, status: int = 200) -> None:
+    def _send_response(
+        self, content, status: int = 200, degraded: bool = False
+    ) -> None:
         body = json.dumps(content).encode()
         self.send_response(status)
         self.send_header("Content-type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if degraded:
+            # the degraded-serving marker (serving/health.py): a header,
+            # not a body key — the body stays byte-identical to the
+            # reference while clients/operators can still see the answer
+            # came from the host-oracle fallback
+            self.send_header("X-Degraded", "true")
         if status == 429:
             retry = retry_after_header(content)
             if retry is not None:
@@ -328,7 +406,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             post_data = self._read_body("/solve", t0)
             if post_data is None:
                 return
-            status, payload, error = solve_route(
+            status, payload, error, degraded = solve_route(
                 self.p2p_node, post_data,
                 deadline_ms=_parse_deadline_ms(
                     self.headers.get("X-Deadline-Ms")
@@ -338,7 +416,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             # instant its response arrives
             shed = status == 429
             self._record("/solve", t0, error=error and not shed, shed=shed)
-            self._send_response(payload, status)
+            self._send_response(payload, status, degraded=degraded)
         elif self.path == "/solve_batch" and self.expose_batch:
             post_data = self._read_body(
                 "/solve_batch", t0, max_bytes=self.MAX_BATCH_BYTES
@@ -366,6 +444,11 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             self._send_response(self.p2p_node.network_view())
         elif self.path == "/metrics" and self.expose_metrics:
             self._send_response(metrics_payload(self.p2p_node))
+        elif self.path == "/healthz":
+            self._send_response(healthz_payload(self.p2p_node))
+        elif self.path == "/readyz":
+            status, payload = readyz_route(self.p2p_node)
+            self._send_response(payload, status)
         else:
             self._send_response({"error": "Invalid endpoint"}, 404)
 

@@ -32,7 +32,7 @@ from ..engine import SolverEngine
 from ..utils import HandicapLimiter
 from . import wire
 from .membership import Membership
-from .stats import StatsGossip
+from .stats import PeerHealth, StatsGossip
 
 logger = logging.getLogger(__name__)
 
@@ -85,6 +85,11 @@ class P2PNode:
             )
         self.membership = Membership(self.id, tombstone_ttl_s=tombstone_ttl_s)
         self.stats = StatsGossip(self.id, self._own_counters)
+        # peers' engine-supervisor states, piggybacked on stats gossip
+        # (wire.stats_msg "health"): the task farm skips LOST peers —
+        # they still answer, but from a host-oracle fallback while an
+        # engine rebuild runs, and a farmed cell should not wait on that
+        self.peer_health = PeerHealth()
 
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.shutdown_flag = False
@@ -220,8 +225,13 @@ class P2PNode:
             # snapshot (lock + fold + dict rebuild) is serving hot path
             return
         snap = self.stats.snapshot()
+        sup = getattr(self.engine, "supervisor", None)
         msg = wire.stats_msg(
-            self.id, self._solved_count, self.engine.validations, snap
+            self.id,
+            self._solved_count,
+            self.engine.validations,
+            snap,
+            health=sup.state if sup is not None else None,
         )
         for peer in peers:
             self.send_to(peer, msg)
@@ -342,6 +352,10 @@ class P2PNode:
 
         elif mtype == "stats":
             self.stats.merge(msg)
+            # supervisor-state piggyback (optional key — absent from
+            # reference traffic and supervisor-less nodes); PeerHealth
+            # validates at the boundary like every other wire field
+            self.peer_health.note(msg["origin"], msg.get("health"))
 
         elif mtype == "disconnect":
             if msg["address"] == self.id:
@@ -411,6 +425,11 @@ class P2PNode:
                         address,
                     )
                     return
+        # a departed peer's health claim dies with it (a rejoin at the
+        # same address starts with a clean slate); unconditional — a
+        # goodbye is authoritative about the peer whether or not it
+        # changed OUR membership view
+        self.peer_health.forget(address)
         changed, redial = self.membership.on_disconnect(address)
         if changed:
             if self.membership.all_peers:
@@ -502,15 +521,30 @@ class P2PNode:
 
     # -- master side -------------------------------------------------------
     def peer_sudoku_solve(self, sudoku, deadline_s=None) -> Optional[list]:
+        """Solve a request board; returns the solved grid or None (the
+        reference surface). ``peer_sudoku_solve_info`` is the same call
+        returning (solution, info) — the HTTP route core uses it for the
+        degraded-serving marker."""
+        solution, _ = self.peer_sudoku_solve_info(
+            sudoku, deadline_s=deadline_s
+        )
+        return solution
+
+    def peer_sudoku_solve_info(self, sudoku, deadline_s=None):
         """Solve a request board, farming cells to peers when there are any
-        (reference node.py:534-557). Returns the solved grid or None.
+        (reference node.py:534-557). Returns (solution | None, info) —
+        ``info`` carries the engine path's routing detail, including the
+        supervisor's ``degraded`` flag when the answer came from the
+        host-oracle fallback (serving/health.py).
 
         ``deadline_s`` (absolute monotonic, from the admission layer) rides
         the engine path into the coalescer, where an expired request is
         dropped at batch formation (DeadlineExceeded propagates to the
-        HTTP layer's 429). The peer task farm ignores it: farmed cells are
-        multi-second round-trips by construction and admission's
-        projected-wait shed is the protection that applies there.
+        HTTP layer's 429). The peer task farm inherits it too (ISSUE 5):
+        dispatched cells carry the sooner of the task deadline and the
+        request's remaining budget, and a request that expires mid-farm
+        stops consuming peer work (DeadlineExceeded) instead of farming
+        cells nobody is waiting for.
 
         With the frontier engine enabled the mesh race *is* the distributed
         path — it replaces the per-cell peer farm for the request (P2P peers
@@ -540,23 +574,25 @@ class P2PNode:
                         raise DeadlineExceeded(
                             "deadline expired waiting for the solve lock"
                         )
-                    solution, _ = self.engine.solve_one(sudoku)
+                    solution, info = self.engine.solve_one(sudoku)
             else:
-                solution, _ = self.engine.solve_one_async(
+                solution, info = self.engine.solve_one_supervised(
                     sudoku, deadline_s=deadline_s
-                ).result()
+                )
             if solution is not None:
                 with self._state_lock:
                     self._solved_count += 1
             self.broadcast_stats()
-            return solution
+            return solution, info
         with self._solve_lock:
-            solution = self._farm_solve(sudoku, peers)
+            solution, info = self._farm_solve(
+                sudoku, peers, deadline_s=deadline_s
+            )
             if solution is not None:
                 with self._state_lock:
                     self._solved_count += 1
             self.broadcast_stats()
-            return solution
+            return solution, info
 
     def batch_sudoku_solve(self, sudokus):
         """Solve many boards in one engine batch (the opt-in
@@ -573,7 +609,9 @@ class P2PNode:
         self.broadcast_stats()
         return solutions, mask, info
 
-    def _farm_solve(self, sudoku, peers: List[str]) -> Optional[list]:
+    def _farm_solve(
+        self, sudoku, peers: List[str], deadline_s=None
+    ) -> Tuple[Optional[list], dict]:
         board = [list(r) for r in sudoku]
         with self._state_lock:
             self.task_queue.clear()
@@ -593,10 +631,22 @@ class P2PNode:
             # board is snapshotted at planning time so the fold below
             # can't mutate a message already planned.
             to_send: List[Tuple[str, wire.Msg]] = []
+            expired = False
             with self._state_lock:
                 # reap deadlined assignments (dead/slow peers: the failure
                 # mode the reference cannot detect, SURVEY.md §3.5)
                 now = time.monotonic()
+                if deadline_s is not None and now > deadline_s:
+                    # the originating /solve's deadline expired mid-farm:
+                    # nobody is waiting for this board anymore, so stop
+                    # consuming peer work (ISSUE 5 satellite — the re-
+                    # dispatch loop would otherwise requeue dying cells
+                    # every TASK_DEADLINE_S forever on a slow cluster).
+                    # Late `solution` datagrams for the abandoned cells
+                    # are absorbed by the existing stale-answer guards.
+                    self.task_queue.clear()
+                    self.active_tasks.clear()
+                    expired = True
                 for peer in list(self.active_tasks):
                     row, col, deadline = self.active_tasks[peer]
                     if now > deadline:
@@ -608,18 +658,33 @@ class P2PNode:
 
                 # dispatch one cell per idle peer (reference node.py:433-442).
                 # Membership is re-read each round so departures (graceful or
-                # detected crashes) shrink the pool mid-solve.
+                # detected crashes) shrink the pool mid-solve. Peers whose
+                # gossiped supervisor state is LOST are skipped — they
+                # would answer from a slow oracle fallback while their
+                # engine rebuilds, and a requeued cell re-dispatches to a
+                # healthy peer instead (gossip TTL un-skips them if the
+                # claim goes stale).
                 live = set(self.membership.total_peers())
-                all_workers_gone = not live and (
+                usable = {
+                    p for p in live if not self.peer_health.is_lost(p)
+                }
+                all_workers_gone = not expired and not usable and (
                     self.task_queue or self.active_tasks
                 )
-                for peer in sorted(live):
+                for peer in sorted(usable):
                     if not self.task_queue:
                         break
                     if peer in self.active_tasks:
                         continue
                     i, j = self.task_queue.popleft()
-                    self.active_tasks[peer] = (i, j, now + TASK_DEADLINE_S)
+                    # a dispatched cell inherits the originating request's
+                    # remaining budget: past it the MASTER stops waiting
+                    # (above), so assigning a later per-task deadline
+                    # would only delay the requeue-or-abandon decision
+                    task_deadline = now + TASK_DEADLINE_S
+                    if deadline_s is not None:
+                        task_deadline = min(task_deadline, deadline_s)
+                    self.active_tasks[peer] = (i, j, task_deadline)
                     to_send.append(
                         (
                             peer,
@@ -662,24 +727,34 @@ class P2PNode:
             for peer, msg in to_send:
                 self.send_to(peer, msg)
 
+            if expired:
+                from ..serving.admission import DeadlineExceeded
+
+                raise DeadlineExceeded(
+                    "request deadline expired mid-farm — peer work stopped"
+                )
+
             if requeued_none or all_workers_gone:
                 # Fall back to the authoritative engine on the original
                 # request when (a) a worker proved its (possibly mixed-merge)
                 # board unsat — replaces the reference's swap-repair
                 # (node.py:487-532) — or (b) every worker departed mid-solve
                 # (the reference would dispatch to dead peers forever).
-                solution, _ = self.engine.solve_one(sudoku, frontier=False)
-                return solution
+                solution, info = self.engine.solve_one(
+                    sudoku, frontier=False
+                )
+                return solution, dict(info, farmed=True)
 
             if done:
                 break
 
         if any(0 in row for row in board):
-            return None
+            return None, {"routed": "farm"}
         # strict final check on the engine (reference runs its weak check,
-        # node.py:466)
-        solution, _ = self.engine.solve_one(board, frontier=False)
-        return solution
+        # node.py:466); its info rides back so a supervised fallback
+        # answer keeps its degraded flag through the farm path
+        solution, info = self.engine.solve_one(board, frontier=False)
+        return solution, dict(info, farmed=True)
 
     @staticmethod
     def _placement_ok(board, row, col, value) -> bool:

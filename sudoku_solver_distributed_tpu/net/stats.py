@@ -15,7 +15,8 @@ concurrently with no locks, SURVEY.md §5).
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict
+import time
+from typing import Callable, Dict, Optional
 
 from .wire import Msg
 
@@ -118,6 +119,91 @@ class StatsGossip:
     # reports "the whole network since it started" (reference README.md:46);
     # their validations happened and the totals stay monotone. This matches
     # the reference's observed behavior (SURVEY.md §3.5).
+
+
+class PeerHealth:
+    """Last-known engine-supervisor state per peer, carried by the
+    ``health`` piggyback on stats gossip (wire.stats_msg, ISSUE 5).
+
+    The task farm reads this to skip LOST peers when dispatching cells
+    (net/node.py _farm_solve): a peer whose device is gone still answers
+    correctly — from its oracle fallback — but multi-second slower, and
+    a master under a request deadline should prefer peers that aren't
+    rebuilding an engine. Entries are evidence, not membership: they
+    EXPIRE (``ttl_s``) so a stale "lost" claim can never exclude a peer
+    whose gossip we have since stopped hearing health for (e.g. its
+    operator detached the supervisor), and a peer's departure forgets it
+    entirely (the node prunes on disconnect).
+    """
+
+    _STATES = frozenset({"warming", "healthy", "degraded", "lost"})
+    MAX_ENTRIES = 256  # flood bound, same rationale as the PR 1
+    #                    all_peers growth cap: spoofed-origin stats
+    #                    floods must exhaust a constant, not the heap
+
+    def __init__(self, ttl_s: float = 15.0):
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._states: Dict[str, tuple] = {}  # peer -> (state, monotonic t)
+
+    def note(self, peer: str, state) -> None:
+        """Fold one gossip-carried health claim; non-states are ignored
+        at the boundary (hostile datagrams must not grow this map with
+        garbage — same ingress rule as every other wire field), and the
+        map itself is bounded: claims for peers nobody asks about are
+        never read (get/snapshot prune lazily), so a spoofed-origin
+        flood would otherwise accumulate forever."""
+        if state not in self._STATES:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._states[peer] = (state, now)
+            if len(self._states) > self.MAX_ENTRIES:
+                for p in [
+                    p
+                    for p, (_, t) in self._states.items()
+                    if now - t > self.ttl_s
+                ]:
+                    del self._states[p]
+            while len(self._states) > self.MAX_ENTRIES:
+                # still over after expiry: evict oldest claims — real
+                # neighbors re-gossip within a second, a flood's spoofed
+                # origins never do
+                oldest = min(self._states.items(), key=lambda kv: kv[1][1])
+                del self._states[oldest[0]]
+
+    def get(self, peer: str) -> Optional[str]:
+        """The peer's last-known state, or None when unknown/expired."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._states.get(peer)
+            if entry is None:
+                return None
+            state, t = entry
+            if now - t > self.ttl_s:
+                del self._states[peer]
+                return None
+            return state
+
+    def is_lost(self, peer: str) -> bool:
+        return self.get(peer) == "lost"
+
+    def forget(self, peer: str) -> None:
+        """Departed peers carry no health (rejoiners start fresh)."""
+        with self._lock:
+            self._states.pop(peer, None)
+
+    def snapshot(self) -> Dict[str, str]:
+        """Unexpired claims, for the /metrics health block."""
+        now = time.monotonic()
+        with self._lock:
+            for peer in [
+                p
+                for p, (_, t) in self._states.items()
+                if now - t > self.ttl_s
+            ]:
+                del self._states[peer]
+            return {p: s for p, (s, _) in self._states.items()}
 
 
 def serving_snapshot(engine) -> Msg:

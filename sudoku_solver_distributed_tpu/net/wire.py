@@ -13,7 +13,10 @@ node's traffic is indistinguishable from the reference's:
               (note: "col" BEFORE "row" — the reference really does emit this
               order, node.py:402)
   stats       {"type", "origin", "solved", "stats": {"address", "validations"},
-               "all_stats"}                            reference node.py:583-592
+               "all_stats"[, "health"]}                reference node.py:583-592
+              ("health" is this stack's optional supervisor-state
+              piggyback — absent unless an EngineSupervisor is attached,
+              keeping default traffic byte-identical)
 """
 
 from __future__ import annotations
@@ -167,11 +170,32 @@ def solution_msg(sudoku, row: int, col: int, solution, self_address: str) -> Msg
     }
 
 
-def stats_msg(origin: str, solved: int, validations: int, all_stats: Msg) -> Msg:
+def stats_msg(
+    origin: str,
+    solved: int,
+    validations: int,
+    all_stats: Msg,
+    health: Optional[str] = None,
+) -> Msg:
+    # ``health`` piggybacks the sender's engine-supervisor state
+    # (serving/health.py: "warming"/"healthy"/"degraded"/"lost") on the
+    # existing 1 Hz stats heartbeat so masters can skip LOST peers when
+    # farming tasks (net/node.py). Optional-and-trailing like
+    # disconnect's row/col: absent when no supervisor is attached, so
+    # the default wire bytes stay identical to the reference's.
+    if health is None:
+        return {
+            "type": "stats",
+            "origin": origin,
+            "solved": solved,
+            "stats": {"address": origin, "validations": validations},
+            "all_stats": all_stats,
+        }
     return {
         "type": "stats",
         "origin": origin,
         "solved": solved,
         "stats": {"address": origin, "validations": validations},
         "all_stats": all_stats,
+        "health": health,
     }

@@ -63,6 +63,23 @@ logger = logging.getLogger(__name__)
 _SENTINEL = object()
 
 
+def _resolve(future: Future, result=None, exc=None) -> None:
+    """Deliver a result/exception to a future that a CALLER may cancel
+    concurrently (engine._await_result cancels starved futures): the
+    ``done()`` pre-check alone races that cancel, and an unguarded
+    ``set_result`` raising InvalidStateError would kill the coalescer
+    thread that calls it — wedging every later batch (code-review)."""
+    if future.done():
+        return
+    try:
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(result)
+    except Exception:  # noqa: BLE001 — cancelled in the race window
+        logger.debug("future resolved after caller cancelled it")
+
+
 class _Request:
     __slots__ = ("board", "future", "enqueued", "deadline")
 
@@ -162,6 +179,12 @@ class BatchCoalescer:
         self.max_batch_fill = 0
         self.max_queue_depth = 0
         self.expired = 0  # requests dropped at batch formation (deadline)
+        # whole batches failed by a device-call exception (dispatch or
+        # completion) — the engine-fault signal an operator correlates
+        # with the supervisor's breaker state on /metrics (ISSUE 5);
+        # every future in a failed batch got the exception, and
+        # supervised serving re-answers those requests from the fallback
+        self.failed_batches = 0
         self._wait_sum_s = 0.0
         self._wait_max_s = 0.0
 
@@ -244,6 +267,11 @@ class BatchCoalescer:
         return req.future
 
     def solve(self, board: np.ndarray):
+        """Blocking convenience for library/test callers. The SERVING
+        path does not use it: engine._solve_one_bucket_direct awaits the
+        submitted future through engine._await_result, which bounds the
+        wait when a supervisor is attached (a hung batch must starve the
+        request into the fallback, not pin the thread)."""
         return self.submit(board).result()
 
     def stats(self) -> dict:
@@ -268,6 +296,7 @@ class BatchCoalescer:
                 "quiescence_ms": round(self.quiescence_s * 1e3, 3),
                 "burst_wait_budget_ms": round(self.burst_wait_s * 1e3, 3),
                 "expired": self.expired,
+                "failed_batches": self.failed_batches,
             }
         with self._cond:
             out["queue_depth"] = len(self._pending)
@@ -369,12 +398,12 @@ class BatchCoalescer:
                 # resolve outside the condition lock: future callbacks run
                 # inline in set_exception and must not re-enter the queue
                 for r in dropped:
-                    if not r.future.done():
-                        r.future.set_exception(
-                            DeadlineExceeded(
-                                "deadline expired in the coalescer queue"
-                            )
-                        )
+                    _resolve(
+                        r.future,
+                        exc=DeadlineExceeded(
+                            "deadline expired in the coalescer queue"
+                        ),
+                    )
             if batch:
                 return batch
             # every drained request had expired: go back to waiting (or
@@ -395,9 +424,10 @@ class BatchCoalescer:
                     handle = self._engine._dispatch_padded(boards)
             except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
                 logger.exception("coalescer dispatch failed")
+                with self._stats_lock:
+                    self.failed_batches += 1
                 for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                    _resolve(r.future, exc=e)
                 continue
             with self._stats_lock:
                 self.batches += 1
@@ -433,15 +463,14 @@ class BatchCoalescer:
                 results = [self._engine._row_result(row) for row in rows]
             except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
                 logger.exception("coalescer completion failed")
+                with self._stats_lock:
+                    self.failed_batches += 1
                 for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                    _resolve(r.future, exc=e)
                 continue
             for r, res in zip(batch, results):
-                # a caller may have cancel()ed its future while the batch
-                # was in flight (futures are never marked running, so
-                # cancel always succeeds); an unguarded set_result would
-                # raise InvalidStateError and kill this thread — wedging
-                # every later batch behind a full hand-off queue
-                if not r.future.done():
-                    r.future.set_result(res)
+                # a caller may cancel() its future while the batch is in
+                # flight (starved supervised awaits do, and futures are
+                # never marked running so cancel always succeeds);
+                # _resolve absorbs the done-check/cancel race
+                _resolve(r.future, result=res)

@@ -1,7 +1,8 @@
-"""Overload control plane: the layer between transport and coalescer.
+"""Serving control planes: overload control and failure-domain supervision.
 
 PR 1 made the serving path fast (request coalescing + lean keep-alive
-transport); this package makes it survive being popular. Three pieces:
+transport); this package makes it survive being popular — and survive its
+own device. Four pieces:
 
   * admission.py — ``AdmissionController``: bounded pending budget +
     per-request deadlines; overload is answered with an honest, cheap
@@ -12,23 +13,36 @@ transport); this package makes it survive being popular. Three pieces:
     and completion rate estimation, driving both the admission
     projection and the adaptive coalescer max-wait (near-zero when idle,
     stretched toward the cap under load — ROADMAP open item 1).
+  * health.py — ``EngineSupervisor`` (ISSUE 5): watchdog + circuit
+    breaker over the engine/device failure domain; DEGRADED/LOST states
+    serve from a bounded host-oracle fallback (correct, slower, flagged)
+    while half-open probes — verified round-trip solves — re-admit the
+    device, and a LOST engine is re-warmed through the compile plane.
   * wiring — net/fastserve.py (bounded worker pool), net/http_api.py
-    (shared 429 route core), net/cli.py (``--admission-capacity``,
-    ``--default-deadline-ms``, ``--adaptive-coalesce``), /metrics
-    (shed/expired counters, rates, current max-wait), and
-    ``bench.py --mode overload`` (the open-loop Poisson proof).
+    (shared 429 route core, /healthz + /readyz), net/cli.py
+    (``--admission-capacity``, ``--default-deadline-ms``,
+    ``--adaptive-coalesce``, ``--supervise-engine``), /metrics
+    (shed/expired counters, rates, current max-wait, health + faults
+    blocks), and ``bench.py --mode overload`` (the open-loop Poisson
+    proof).
 
 Everything defaults off: a node started without the new flags serves
 byte-identically to the PR 1 stack.
 """
 
 from .admission import AdmissionController, Decision, DeadlineExceeded
+from .health import DEGRADED, HEALTHY, LOST, WARMING, EngineSupervisor
 from .load import AdaptiveWaitPolicy, EwmaRate, WindowRate
 
 __all__ = [
     "AdmissionController",
     "Decision",
     "DeadlineExceeded",
+    "EngineSupervisor",
+    "WARMING",
+    "HEALTHY",
+    "DEGRADED",
+    "LOST",
     "AdaptiveWaitPolicy",
     "EwmaRate",
     "WindowRate",

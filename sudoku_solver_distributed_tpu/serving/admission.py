@@ -102,6 +102,7 @@ class AdmissionController:
         self.completed = 0
         self.expired = 0   # admitted but dropped/expired before completing
         self.rejected = 0  # admitted but finished without engine service
+        self.reanchors = 0  # capacity-estimator resets (regime changes)
         self.arrivals = EwmaRate(tau_s=tau_s)
         # count-based, NOT gap-based: completions fan out in bursts (a
         # coalesced batch resolves 8 futures at once) and a gap EWMA
@@ -174,6 +175,20 @@ class AdmissionController:
         with self._lock:
             return self._retry_after_s(self._projected_wait_s())
 
+    def reanchor(self) -> None:
+        """Re-anchor the capacity estimator on the CURRENT serving
+        regime. Wired to the engine supervisor's state transitions
+        (serving/health.py via net/cli.py): when the device is lost the
+        projection must measure the host-oracle fallback's throughput —
+        not keep admitting against a dead device's held peak rate — and
+        when the device is re-admitted the fallback's slow rate must not
+        shed traffic the repaired device could serve. The batch-formation
+        expiry backstop bounds the brief optimism while the estimator
+        re-learns (load.WindowRate.reanchor)."""
+        with self._lock:
+            self.reanchors += 1
+            self._completions.reanchor()
+
     def release(self, *, expired: bool = False, served: bool = True) -> None:
         """One admitted request finished (solved, failed, or expired).
 
@@ -209,6 +224,7 @@ class AdmissionController:
                 "shed_deadline": self.shed_deadline,
                 "expired": self.expired,
                 "rejected": self.rejected,
+                "reanchors": self.reanchors,
                 "default_deadline_ms": round(
                     (self.default_deadline_s or 0.0) * 1e3, 3
                 ),

@@ -1,12 +1,14 @@
 """Host-side utilities: handicap rate limiting, board rendering, fault
 injection, logging."""
 
-from .faults import FaultInjector
+from .faults import EngineFaultInjector, FaultInjector, InjectedEngineFault
 from .ratelimit import HandicapLimiter
 from .render import render_board, render_board_highlight_zeros
 
 __all__ = [
+    "EngineFaultInjector",
     "FaultInjector",
+    "InjectedEngineFault",
     "HandicapLimiter",
     "render_board",
     "render_board_highlight_zeros",

@@ -1,0 +1,247 @@
+"""Seeded chaos soak: wire + engine faults together, end to end (ISSUE 5).
+
+The acceptance demonstration: with engine-seam faults injected
+(fail-next-N, a poisoned program, a hang that trips the watchdog),
+``/solve`` keeps returning oracle-verified correct boards in DEGRADED
+mode — flagged in the response and on ``/metrics`` — and the circuit
+breaker returns the node to HEALTHY after the faults clear, with zero
+hung, dropped, or silently-wrong requests across every transition. The
+farm soak runs the same storm through the P2P plane with wire faults on
+top (dropped dispatches + deadline requeue + engine faults on the
+workers' shared engine).
+
+Slow-marked: tier-1 excludes it; CI runs it as the dedicated
+``chaos-smoke`` job (.github/workflows/ci.yml) after graftcheck.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    oracle_is_valid_solution,
+)
+from sudoku_solver_distributed_tpu.net import node as nodemod
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.serving.health import (
+    HEALTHY,
+    EngineSupervisor,
+)
+from sudoku_solver_distributed_tpu.utils import (
+    EngineFaultInjector,
+    FaultInjector,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def check_board(puzzle, grid):
+    assert oracle_is_valid_solution(grid), grid
+    for i, row in enumerate(puzzle):
+        for j, v in enumerate(row):
+            if v:
+                assert grid[i][j] == v, (i, j)
+
+
+def test_chaos_engine_soak_http_correct_or_clean_never_wrong():
+    eng = SolverEngine(
+        buckets=(1, 8), coalesce=True, coalesce_max_wait_s=0.0
+    )
+    eng.warmup()
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(
+        eng,
+        watchdog_budget_s=0.5,
+        breaker_threshold=3,
+        probe_interval_s=0.1,
+        fallback_concurrency=4,
+    )
+    node = P2PNode("127.0.0.1", free_port(), engine=eng)
+    httpd = make_http_server(
+        node, "127.0.0.1", free_port(), expose_metrics=True
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+    boards = [
+        b.tolist() for b in generate_batch(24, 4, seed=1337, unique=True)
+    ]
+
+    results = []
+    results_lock = threading.Lock()
+
+    def fire(batch):
+        """POST each board concurrently; every request must complete with
+        a JSON reply (no hangs, no dropped connections)."""
+        threads = []
+
+        def one(board):
+            req = urllib.request.Request(
+                f"{base}/solve",
+                data=json.dumps({"sudoku": board}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    out = (board, r.status, r.headers.get("X-Degraded"),
+                           json.loads(r.read()), None)
+            except urllib.error.HTTPError as e:
+                out = (board, e.code, e.headers.get("X-Degraded"),
+                       json.loads(e.read()), None)
+            except Exception as e:  # noqa: BLE001 — a hang/drop fails the soak
+                out = (board, None, None, None, e)
+            with results_lock:
+                results.append(out)
+
+        for board in batch:
+            t = threading.Thread(target=one, args=(board,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=90)
+            assert not t.is_alive(), "client thread hung"
+
+    try:
+        # phase A — healthy baseline
+        fire(boards[:6])
+        # phase B — dead device calls: the breaker opens, fallback serves
+        inj.arm_fail_next(6)
+        fire(boards[6:14])
+        assert sup.state != HEALTHY or sup.failures >= 1
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            metrics = json.loads(r.read())
+        assert metrics["health"]["state"] in ("degraded", "lost")
+        assert metrics["health"]["fallback"]["served"] >= 1
+        assert metrics["faults"]["engine"]["failed"] >= 1
+        # phase C — faults clear; half-open probes re-admit the device
+        inj.clear()
+        assert wait_for(lambda: sup.state == HEALTHY), sup.snapshot()
+        # phase D — poisoned program: wrong answers must never escape
+        inj.poison_bucket(1)
+        inj.poison_bucket(8)
+        fire(boards[14:18])
+        inj.clear()
+        assert wait_for(lambda: sup.state == HEALTHY), sup.snapshot()
+        assert sup.bad_results >= 1
+        # phase E — hang: the watchdog trips while the call sleeps
+        inj.set_delay(1.5)
+        fire(boards[18:20])
+        assert sup.hangs >= 1
+        inj.clear()
+        assert wait_for(lambda: sup.state == HEALTHY), sup.snapshot()
+        # phase F — healthy again, no degraded flags
+        fire(boards[20:])
+
+        assert len(results) == len(boards)
+        degraded_seen = 0
+        for board, status, marker, payload, exc in results:
+            assert exc is None, f"request hung/dropped: {exc!r}"
+            # every answer is a 200 with an oracle-verified correct
+            # board — the faults were masked, not surfaced (4xx would
+            # also be "clean", but these puzzles are all solvable and
+            # the fallback is always available)
+            assert status == 200, payload
+            check_board(board, payload)
+            if marker == "true":
+                degraded_seen += 1
+        assert degraded_seen >= 1  # DEGRADED mode visibly served traffic
+        # and the node ended the storm healthy and ready
+        with urllib.request.urlopen(f"{base}/readyz", timeout=10) as r:
+            assert json.loads(r.read())["health"] == "healthy"
+    finally:
+        httpd.shutdown()
+        sup.close()
+        eng.close()
+
+
+def test_chaos_wire_and_engine_farm_soak(monkeypatch):
+    """The P2P task farm under BOTH fault domains at once: dropped task
+    dispatches/answers (wire injector, seeded) while the shared engine
+    takes fail-next bursts (workers answer farmed cells from the
+    supervised fallback). Every farmed solve must still produce a
+    correct board — the deadline-requeue and fallback machinery mask
+    both domains."""
+    monkeypatch.setattr(nodemod, "TASK_DEADLINE_S", 0.4)
+    eng = SolverEngine(buckets=(1,), coalesce=False)
+    eng.warmup()
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(
+        eng,
+        watchdog_budget_s=5.0,
+        breaker_threshold=3,
+        probe_interval_s=0.1,
+    )
+    wire_faults = FaultInjector(
+        drop={"solve": 0.3, "solution": 0.2},
+        drop_first={"solve": 1},
+        seed=4242,
+    )
+    nodes = []
+    try:
+        anchor = None
+        for faults in (wire_faults, None):
+            port = free_port()
+            n = P2PNode(
+                "127.0.0.1",
+                port,
+                anchor_node=anchor,
+                handicap=0.0,
+                engine=eng,
+                fault_injector=faults,
+            )
+            if anchor is None:
+                anchor = f"127.0.0.1:{port}"
+            nodes.append(n)
+        for n in nodes:
+            threading.Thread(target=n.run, daemon=True).start()
+        assert wait_for(
+            lambda: all(
+                len(n.membership.total_peers()) == 1 for n in nodes
+            ),
+            timeout=10.0,
+        )
+        boards = [
+            b.tolist() for b in generate_batch(6, 3, seed=99, unique=True)
+        ]
+        for k, board in enumerate(boards):
+            if k == 2:
+                inj.arm_fail_next(3)  # mid-soak engine fault burst
+            solution = nodes[0].peer_sudoku_solve(board)
+            assert solution is not None
+            check_board(board, solution)
+        inj.clear()
+        assert wait_for(lambda: sup.state == HEALTHY), sup.snapshot()
+        # the wire storm actually happened (not a vacuous pass)
+        assert wire_faults.counts()["dropped"]
+    finally:
+        for n in nodes:
+            n.shutdown_flag = True
+            n.sock.close()
+        sup.close()
+        eng.close()

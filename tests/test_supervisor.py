@@ -1,0 +1,874 @@
+"""Engine failure-domain supervision (ISSUE 5, serving/health.py).
+
+Deterministic coverage of every state-machine edge — device-call failure
+→ DEGRADED, watchdog hang trip, consecutive failures → LOST, half-open
+probe success/failure, automatic rebuild re-entering HEALTHY — plus the
+satellites that ride the plane: /healthz + /readyz on both transports
+(byte-identical), the X-Degraded response marker, the /metrics health and
+faults blocks, deadline propagation into the task farm, the LOST-peer
+skip fed by the stats-gossip health piggyback, and the admission
+capacity-estimator re-anchor. Faults come from the engine-seam injector
+(utils/faults.EngineFaultInjector) — no sleep-and-hope, every transition
+is provoked on purpose.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+from sudoku_solver_distributed_tpu.net import wire
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode, TASK_DEADLINE_S
+from sudoku_solver_distributed_tpu.serving import (
+    AdmissionController,
+    DeadlineExceeded,
+    WindowRate,
+)
+from sudoku_solver_distributed_tpu.serving.health import (
+    DEGRADED,
+    HEALTHY,
+    LOST,
+    WARMING,
+    EngineSupervisor,
+)
+from sudoku_solver_distributed_tpu.utils import (
+    EngineFaultInjector,
+    InjectedEngineFault,
+)
+
+
+def free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+BOARD = [[0] * 9 for _ in range(9)]
+BOARD[0][0] = 5  # one clue: solvable, instant, and clue-check-able
+
+
+def wait_for(pred, timeout=8.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1, 4), coalesce=False)
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+@pytest.fixture
+def supervised(engine):
+    """The shared engine with a fresh supervisor + injector per test.
+    probe_interval is huge: tests drive probes by hand, deterministically
+    (the auto-probe path gets its own test)."""
+    inj = EngineFaultInjector()
+    engine.fault_injector = inj
+    sup = EngineSupervisor(
+        engine,
+        watchdog_budget_s=0.15,
+        breaker_threshold=3,
+        probe_interval_s=600.0,
+    )
+    yield engine, sup, inj
+    sup.close()
+    engine.supervisor = None
+    engine.fault_injector = None
+
+
+# -- state machine edges -----------------------------------------------------
+
+
+def test_warming_promotes_to_healthy_on_first_verified_success():
+    eng = SolverEngine(buckets=(1,), coalesce=False)  # never warmed
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(eng, probe_interval_s=600.0)
+    try:
+        assert sup.state == WARMING
+        solution, info = eng.solve_one(BOARD)
+        assert solution is not None
+        assert sup.state == HEALTHY
+    finally:
+        sup.close()
+        eng.close()
+
+
+def test_device_failure_trips_degraded_and_fallback_answers(supervised):
+    engine, sup, inj = supervised
+    assert sup.state == HEALTHY
+    inj.arm_fail_next(1)
+    solution, info = engine.solve_one(BOARD)
+    # the request that HIT the fault still gets a correct answer
+    assert solution is not None and oracle_is_valid_solution(solution)
+    assert solution[0][0] == 5
+    assert info["degraded"] and info["routed"] == "oracle-fallback"
+    assert sup.state == DEGRADED
+    # while DEGRADED the device is not touched: fallback serves directly
+    calls_before = inj.counts()["calls"]
+    solution, info = engine.solve_one(BOARD)
+    assert solution is not None and info["degraded"]
+    assert inj.counts()["calls"] == calls_before
+
+
+def test_consecutive_failures_escalate_to_lost(supervised):
+    engine, sup, inj = supervised
+    inj.arm_fail_next(10)
+    engine.solve_one(BOARD)  # failure 1 -> DEGRADED
+    assert sup.state == DEGRADED
+    assert sup.probe() is False  # failure 2 (half-open, still faulty)
+    assert sup.state == DEGRADED
+    assert sup.probe() is False  # failure 3 -> breaker fully open
+    assert sup.state == LOST
+    assert sup.consecutive_failures >= 3
+    assert sup.probe_failures == 2
+
+
+def test_half_open_probe_readmits_after_faults_clear(supervised):
+    engine, sup, inj = supervised
+    inj.arm_fail_next(1)
+    engine.solve_one(BOARD)
+    assert sup.state == DEGRADED
+    inj.clear()
+    assert sup.probe() is True
+    assert sup.state == HEALTHY
+    assert sup.consecutive_failures == 0
+    assert sup.quarantined_widths() == frozenset()
+    # the device serves again — no degraded flag, injector sees the call
+    calls_before = inj.counts()["calls"]
+    solution, info = engine.solve_one(BOARD)
+    assert solution is not None and not info.get("degraded")
+    assert inj.counts()["calls"] == calls_before + 1
+
+
+def test_watchdog_declares_hung_call_and_late_finish_cannot_readmit(
+    supervised,
+):
+    engine, sup, inj = supervised
+    inj.set_delay(0.6)  # >> the 0.15 s watchdog budget
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(r=engine.solve_one(BOARD)), daemon=True
+    )
+    t.start()
+    # the trip happens while the call is STILL inside the device seam
+    assert wait_for(lambda: sup.state == DEGRADED, timeout=5.0)
+    assert sup.hangs >= 1
+    assert 1 in sup.quarantined_widths()  # the hung bucket is quarantined
+    t.join(timeout=10)
+    solution, info = result["r"]
+    # the hung request was never dropped: its (late) answer is correct
+    assert solution is not None and oracle_is_valid_solution(solution)
+    # a late clean finish is counted but does NOT close the breaker
+    assert sup.state == DEGRADED
+    assert sup.late_successes >= 1
+    inj.clear()
+    assert sup.probe() is True
+    assert sup.state == HEALTHY
+
+
+def test_quarantined_width_routes_to_next_bucket(supervised):
+    engine, sup, inj = supervised
+    inj.set_delay(0.6)
+    t = threading.Thread(
+        target=lambda: engine.solve_one(BOARD), daemon=True
+    )
+    t.start()
+    assert wait_for(lambda: 1 in sup.quarantined_widths(), timeout=5.0)
+    # routing avoids the quarantined width; the ladder still covers n=1
+    assert engine._bucket_for(1) == 4
+    t.join(timeout=10)
+    inj.clear()
+    assert sup.probe() is True
+    assert engine._bucket_for(1) == 1
+
+
+def test_poisoned_program_never_serves_a_wrong_answer(supervised):
+    engine, sup, inj = supervised
+    inj.poison_bucket(1)
+    solution, info = engine.solve_one(BOARD)
+    # host-side verification caught the corrupt grid; the oracle answered
+    assert solution is not None and oracle_is_valid_solution(solution)
+    assert solution[0][0] == 5
+    assert info["degraded"]
+    assert sup.bad_results >= 1
+    assert sup.state == DEGRADED
+    inj.clear()
+    assert sup.probe() is True
+
+
+def test_lost_engine_rebuilds_and_reenters_healthy_automatically(engine):
+    """The full LOST episode end to end, on the watchdog's own clock:
+    breaker opens, the background rebuild re-warms through the compile
+    plane, the auto-probe verifies a round trip, HEALTHY again."""
+    inj = EngineFaultInjector()
+    engine.fault_injector = inj
+    sup = EngineSupervisor(
+        engine,
+        watchdog_budget_s=5.0,
+        breaker_threshold=1,  # first failure goes straight to LOST
+        probe_interval_s=0.1,
+    )
+    try:
+        inj.arm_fail_next(1)
+        solution, info = engine.solve_one(BOARD)
+        assert solution is not None and info["degraded"]
+        assert sup.state == LOST
+        inj.clear()
+        # rebuild (warmup) + half-open probe run on supervisor threads
+        assert wait_for(lambda: sup.state == HEALTHY, timeout=10.0)
+        assert sup.rebuilds >= 1
+        assert sup.probes >= 1
+        solution, info = engine.solve_one(BOARD)
+        assert solution is not None and not info.get("degraded")
+    finally:
+        sup.close()
+        engine.supervisor = None
+        engine.fault_injector = None
+
+
+def test_supervised_coalesced_path_falls_back_on_batch_failure():
+    """The serving default (coalesce=True): a dispatch fault fails the
+    whole batch's futures; solve_one_supervised re-answers from the
+    fallback instead of erroring the request."""
+    eng = SolverEngine(buckets=(1, 4), coalesce=True, coalesce_max_wait_s=0.0)
+    eng.warmup()
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(eng, probe_interval_s=600.0)
+    try:
+        solution, info = eng.solve_one_supervised(BOARD)
+        assert solution is not None and not info.get("degraded")
+        inj.arm_fail_next(1)
+        solution, info = eng.solve_one_supervised(BOARD)
+        assert solution is not None and oracle_is_valid_solution(solution)
+        assert info["degraded"]
+        assert sup.state == DEGRADED
+        assert eng.coalescer.stats()["failed_batches"] >= 1
+        # deadline semantics survive supervision: an expired request
+        # sheds, it does not burn fallback work
+        with pytest.raises(DeadlineExceeded):
+            eng.solve_one_supervised(
+                BOARD, deadline_s=time.monotonic() - 1.0
+            )
+    finally:
+        sup.close()
+        eng.close()
+
+
+def test_starved_future_falls_back_instead_of_pinning_the_handler(
+    supervised,
+):
+    """A TRULY hung device call never resolves its futures; the
+    supervised await is bounded (2×watchdog+5s) and the request is
+    re-answered by the fallback instead of pinning a transport worker
+    forever (code-review)."""
+    from concurrent.futures import Future
+
+    engine, sup, inj = supervised
+    never = Future()  # the hung batch's future: nobody will resolve it
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="starved"):
+        engine._await_result(never)
+    assert time.monotonic() - t0 < 2.0 * sup.watchdog_budget_s + 8.0
+    assert never.cancelled()  # the completer's done() guard will skip it
+    # the full path: a starved call is just another device failure
+    solution, info = engine._supervised_answer(
+        sup, np.asarray(BOARD, np.int32),
+        lambda: engine._await_result(Future()),
+    )
+    assert solution is not None and info["degraded"]
+
+
+def test_abandoned_probe_slot_is_reclaimed(supervised):
+    """A probe thread stuck in a hung device call must not wedge
+    recovery: past the abandon horizon the watchdog reclaims the slot so
+    a later probe can re-admit the device (code-review)."""
+    engine, sup, inj = supervised
+    inj.arm_fail_next(1)
+    engine.solve_one(BOARD)
+    assert sup.state == DEGRADED
+    inj.clear()
+    # simulate a probe thread that went silent long ago
+    with sup._lock:
+        sup._probe_inflight = True
+        sup._probe_started = time.monotonic() - sup._probe_abandon_s() - 1
+        sup._probe_due = 0.0
+    sup.probe_interval_s = 0.05  # let the watchdog schedule a fresh one
+    assert wait_for(lambda: sup.probes_abandoned >= 1, timeout=5.0)
+    assert wait_for(lambda: sup.state == HEALTHY, timeout=5.0)
+    # a zombie probe finishing late must not clear a NEWER probe's slot
+    with sup._lock:
+        sup._probe_inflight = True
+        sup._probe_epoch += 1
+        current = sup._probe_epoch
+    sup._probe_and_maybe_rebuild(False, current - 1)  # stale epoch
+    assert sup._probe_inflight
+    with sup._lock:
+        sup._probe_inflight = False
+
+
+def test_first_call_on_unseen_width_is_not_declared_hung():
+    """A width's first call may be a legitimately long trace+compile:
+    the watchdog must not quarantine a compiling program (code-review).
+    Once the width has completed a call, the same delay IS a hang."""
+    eng = SolverEngine(buckets=(1,), coalesce=False)  # never warmed
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(
+        eng, watchdog_budget_s=0.15, probe_interval_s=600.0
+    )
+    try:
+        inj.set_delay(0.5)  # >> budget, rides the first (compile) call
+        solution, _info = eng.solve_one(BOARD)
+        assert solution is not None
+        assert sup.hangs == 0 and sup.state == HEALTHY
+        # second call on the now-proven width: the delay is a real hang
+        t = threading.Thread(
+            target=lambda: eng.solve_one(BOARD), daemon=True
+        )
+        t.start()
+        assert wait_for(lambda: sup.hangs >= 1, timeout=5.0)
+        t.join(timeout=10)
+    finally:
+        sup.close()
+        eng.close()
+
+
+def test_wrong_unsat_claim_is_caught_and_served_from_oracle(supervised):
+    """A poisoned program that CLEARS the solved flag (instead of
+    corrupting the grid) claims UNSAT for solvable boards — the sibling
+    silent-wrong-answer shape; the supervised path cross-checks the
+    claim and trips the breaker (code-review)."""
+    engine, sup, inj = supervised
+    arr = np.asarray(BOARD, np.int32)
+    solution, info = engine._supervised_answer(
+        sup, arr, lambda: (None, {"validations": 0})
+    )
+    assert solution is not None and oracle_is_valid_solution(solution)
+    assert info["degraded"]
+    assert sup.bad_results >= 1 and sup.state == DEGRADED
+    inj.clear()
+    assert sup.probe() is True
+    # a GENUINE unsat claim passes through untouched (no breaker food)
+    unsat = [row[:] for row in BOARD]
+    unsat[0][1] = 5  # clashes with the (0,0)=5 clue
+    bad_before = sup.bad_results
+    solution, info = engine._supervised_answer(
+        sup, np.asarray(unsat, np.int32),
+        lambda: (None, {"validations": 0}),
+    )
+    assert solution is None
+    assert sup.bad_results == bad_before and sup.state == HEALTHY
+    # capped (= not finished, NOT proven unsat) is exempt from recheck
+    solution, info = engine._supervised_answer(
+        sup, arr, lambda: (None, {"validations": 0, "capped": 1})
+    )
+    assert solution is None and sup.state == HEALTHY
+
+
+def test_failed_dispatch_does_not_spend_first_compile_exemption():
+    """A call that failed AT DISPATCH (before any compile work) must not
+    mark its width 'seen': the width's real first call is still a
+    legitimately long trace+compile the watchdog must excuse
+    (code-review)."""
+    eng = SolverEngine(buckets=(1,), coalesce=False)  # never warmed
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(
+        eng, watchdog_budget_s=0.15, probe_interval_s=600.0
+    )
+    try:
+        inj.arm_fail_next(1)
+        solution, info = eng.solve_one(BOARD)  # fails pre-compile
+        assert solution is not None and info["degraded"]
+        assert 1 not in sup._seen_widths
+        # the width's true first completion, slower than the budget:
+        # excused (it may be the compile), probe succeeds, no hang
+        inj.clear()
+        inj.set_delay(0.5)
+        assert sup.probe() is True
+        assert sup.hangs == 0 and sup.state == HEALTHY
+        # now the width is proven: the same delay IS a hang
+        t = threading.Thread(
+            target=lambda: eng.solve_one(BOARD), daemon=True
+        )
+        t.start()
+        assert wait_for(lambda: sup.hangs >= 1, timeout=5.0)
+        t.join(timeout=10)
+    finally:
+        sup.close()
+        eng.close()
+
+
+def test_probe_quarantine_bypass_is_thread_local(supervised):
+    """While a probe re-tries the quarantined width, OTHER threads must
+    keep routing around it (a global bypass would send live traffic
+    into the hung/poisoned program during every probe window —
+    code-review)."""
+    engine, sup, inj = supervised
+    inj.set_delay(0.5)
+    t = threading.Thread(target=lambda: engine.solve_one(BOARD), daemon=True)
+    t.start()
+    assert wait_for(lambda: 1 in sup.quarantined_widths(), timeout=5.0)
+    t.join(timeout=10)
+    # run a probe that itself stalls (delay still armed) and observe the
+    # quarantine from this (serving) thread mid-probe
+    pt = threading.Thread(target=sup.probe, daemon=True)
+    pt.start()
+    time.sleep(0.1)  # probe is inside its delayed device call now
+    assert 1 in sup.quarantined_widths()  # serving threads still avoid it
+    pt.join(timeout=10)
+    inj.clear()
+    assert sup.probe() is True
+    assert sup.quarantined_widths() == frozenset()
+
+
+def test_resolve_survives_caller_cancel_race():
+    """A starved supervised await cancels its future; the coalescer
+    thread delivering the late result must survive the race instead of
+    dying on InvalidStateError (code-review)."""
+    from concurrent.futures import Future
+
+    from sudoku_solver_distributed_tpu.parallel.coalescer import _resolve
+
+    fut = Future()
+    fut.cancel()
+    _resolve(fut, result=("x", {}))  # must not raise
+    _resolve(fut, exc=RuntimeError("late"))  # must not raise
+    fut2 = Future()
+    _resolve(fut2, result=("y", {}))
+    assert fut2.result(timeout=1) == ("y", {})
+
+
+def test_fallback_sheds_request_that_expired_waiting_for_the_slot(
+    supervised,
+):
+    engine, sup, inj = supervised
+    inj.arm_fail_next(1)
+    engine.solve_one(BOARD)
+    assert sup.state == DEGRADED
+    with pytest.raises(DeadlineExceeded):
+        sup.fallback_solve(BOARD, deadline_s=time.monotonic() - 0.1)
+    # without a deadline the fallback still serves
+    solution, info = sup.fallback_solve(BOARD)
+    assert solution is not None and info["degraded"]
+    inj.clear()
+    assert sup.probe() is True
+
+
+def test_farm_fallback_answer_keeps_degraded_flag(engine, monkeypatch):
+    """A farm-path request answered by the supervised local engine's
+    oracle fallback must still carry degraded=True to the HTTP marker
+    (code-review)."""
+    inj = EngineFaultInjector()
+    engine.fault_injector = inj
+    sup = EngineSupervisor(engine, probe_interval_s=600.0)
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    try:
+        monkeypatch.setattr(
+            node.membership, "total_peers", lambda: ["127.0.0.1:1"]
+        )
+        monkeypatch.setattr(node, "send_to", lambda peer, msg: None)
+        node.peer_health.note("127.0.0.1:1", "lost")  # farm falls back
+        inj.arm_fail_next(1)
+        solution, info = node.peer_sudoku_solve_info(BOARD)
+        assert solution is not None and oracle_is_valid_solution(solution)
+        assert info["degraded"] and info["farmed"]
+    finally:
+        sup.close()
+        engine.supervisor = None
+        engine.fault_injector = None
+
+
+def test_peer_health_map_is_bounded_under_spoofed_flood():
+    from sudoku_solver_distributed_tpu.net.stats import PeerHealth
+
+    ph = PeerHealth(ttl_s=600.0)  # nothing expires during the flood
+    for k in range(PeerHealth.MAX_ENTRIES + 100):
+        ph.note(f"10.0.0.{k}:{k}", "lost")
+    assert len(ph._states) <= PeerHealth.MAX_ENTRIES
+    # the newest claims survive the eviction
+    assert ph.is_lost(f"10.0.0.{PeerHealth.MAX_ENTRIES + 99}:"
+                      f"{PeerHealth.MAX_ENTRIES + 99}")
+
+
+# -- injector unit ------------------------------------------------------------
+
+
+def test_engine_injector_deterministic_counts():
+    inj = EngineFaultInjector(fail_next=2)
+    with pytest.raises(InjectedEngineFault):
+        inj.on_device_call(1)
+    with pytest.raises(InjectedEngineFault):
+        inj.on_device_call(1)
+    inj.on_device_call(1)  # budget spent: passes
+    counts = inj.counts()
+    assert counts["calls"] == 3 and counts["failed"] == 2
+    assert counts["armed_fail_next"] == 0
+    packed = np.zeros((1, 85), np.int32)
+    packed[0, 0], packed[0, 1] = 1, 2
+    same = inj.corrupt(1, packed)
+    assert same[0, 0] == 1  # unarmed: untouched
+    inj.poison_bucket(1)
+    poisoned = inj.corrupt(1, packed)
+    assert poisoned[0, 0] == poisoned[0, 1]
+    assert packed[0, 0] == 1  # original batch is never mutated in place
+    inj.clear()
+    assert inj.counts()["armed_poison_buckets"] == []
+
+
+# -- /healthz + /readyz (both transports, byte-identical) ---------------------
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(f"{base}{path}", timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _serve(node, legacy):
+    httpd = make_http_server(
+        node, "127.0.0.1", free_port(), legacy_transport=legacy,
+        expose_metrics=True,
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+
+
+def test_healthz_readyz_byte_identical_across_transports(supervised):
+    engine, sup, inj = supervised
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    fast, fast_base = _serve(node, legacy=False)
+    stock, stock_base = _serve(node, legacy=True)
+    try:
+        for path in ("/healthz", "/readyz"):
+            fs, fb = _get(fast_base, path)
+            ss, sb = _get(stock_base, path)
+            assert (fs, fb) == (ss, sb), path
+        status, body = _get(fast_base, "/healthz")
+        assert status == 200 and json.loads(body) == {"ok": True}
+        status, body = _get(fast_base, "/readyz")
+        assert status == 200
+        assert json.loads(body) == {
+            "ready": True,
+            "warmed": True,
+            "health": "healthy",
+        }
+        # LOST -> readiness gates traffic away (503), liveness stays 200
+        inj.arm_fail_next(10)
+        engine.solve_one(BOARD)
+        sup.probe()
+        sup.probe()
+        assert sup.state == LOST
+        for base in (fast_base, stock_base):
+            status, body = _get(base, "/readyz")
+            assert status == 503
+            assert json.loads(body)["health"] == "lost"
+            assert _get(base, "/healthz")[0] == 200
+        inj.clear()
+        assert sup.probe() is True
+    finally:
+        fast.shutdown()
+        stock.shutdown()
+
+
+def test_readyz_not_ready_before_warm():
+    eng = SolverEngine(buckets=(1,), coalesce=False)  # warmed=False
+    node = P2PNode("127.0.0.1", free_port(), engine=eng)
+    httpd, base = _serve(node, legacy=False)
+    try:
+        status, body = _get(base, "/readyz")
+        assert status == 503
+        assert json.loads(body) == {"ready": False, "warmed": False}
+    finally:
+        httpd.shutdown()
+        eng.close()
+
+
+# -- degraded marker + /metrics blocks ----------------------------------------
+
+
+def test_degraded_marker_and_metrics_blocks_on_both_transports(supervised):
+    engine, sup, inj = supervised
+    wire_inj = __import__(
+        "sudoku_solver_distributed_tpu.utils", fromlist=["FaultInjector"]
+    ).FaultInjector(drop_first={"solve": 1})
+    node = P2PNode(
+        "127.0.0.1", free_port(), engine=engine, fault_injector=wire_inj
+    )
+    fast, fast_base = _serve(node, legacy=False)
+    stock, stock_base = _serve(node, legacy=True)
+    try:
+        body = json.dumps({"sudoku": BOARD}).encode()
+
+        def post(base):
+            req = urllib.request.Request(
+                f"{base}/solve", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.headers.get("X-Degraded"), json.loads(
+                    r.read()
+                )
+
+        status, marker, grid = post(fast_base)
+        assert status == 200 and marker is None
+
+        inj.arm_fail_next(1)
+        engine.solve_one(BOARD)  # trip the breaker
+        assert sup.state == DEGRADED
+        for base in (fast_base, stock_base):
+            status, marker, grid = post(base)
+            assert status == 200
+            assert marker == "true"  # flagged, body still the bare grid
+            assert oracle_is_valid_solution(grid) and grid[0][0] == 5
+
+        with urllib.request.urlopen(f"{fast_base}/metrics", timeout=10) as r:
+            metrics = json.loads(r.read())
+        assert metrics["health"]["state"] == "degraded"
+        assert metrics["health"]["fallback"]["served"] >= 2
+        assert metrics["faults"]["engine"]["failed"] >= 1
+        assert metrics["faults"]["wire"]["dropped"] == {}  # armed, unhit
+        assert metrics["engine"]["supervisor"] == "degraded"
+
+        inj.clear()
+        assert sup.probe() is True
+        status, marker, _ = post(fast_base)
+        assert status == 200 and marker is None
+    finally:
+        fast.shutdown()
+        stock.shutdown()
+
+
+# -- satellite: deadline propagation into the task farm -----------------------
+
+
+@pytest.fixture
+def farm_node(engine, monkeypatch):
+    """A master with one FAKE peer: dispatches are captured, never sent,
+    so the farm's deadline machinery is observable deterministically."""
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    sent = []
+    monkeypatch.setattr(
+        node.membership, "total_peers", lambda: ["127.0.0.1:1"]
+    )
+    monkeypatch.setattr(
+        node, "send_to", lambda peer, msg: sent.append((peer, msg))
+    )
+    return node, sent
+
+
+def test_farm_inherits_request_deadline_and_stops_at_expiry(farm_node):
+    node, sent = farm_node
+    deadline_s = time.monotonic() + 0.4
+    got = {}
+
+    def run():
+        try:
+            got["r"] = node.peer_sudoku_solve_info(
+                BOARD, deadline_s=deadline_s
+            )
+        except BaseException as e:  # noqa: BLE001 — assert on it below
+            got["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t0 = time.monotonic()
+    t.start()
+    # the dispatched cell's per-task deadline is the REQUEST deadline,
+    # not now + TASK_DEADLINE_S (5 s)
+    assert wait_for(lambda: node.active_tasks, timeout=3.0)
+    (_row, _col, task_deadline) = next(iter(node.active_tasks.values()))
+    assert task_deadline == pytest.approx(deadline_s, abs=0.05)
+    assert task_deadline < t0 + TASK_DEADLINE_S - 1.0
+    t.join(timeout=10)
+    elapsed = time.monotonic() - t0
+    # a dying request stops consuming peer work at its deadline — it does
+    # not grind through 5 s requeue cycles
+    assert isinstance(got.get("exc"), DeadlineExceeded), got
+    assert elapsed < 2.0
+    assert not node.active_tasks and not node.task_queue
+    assert any(m["type"] == "solve" for _p, m in sent)
+
+
+def test_farm_without_deadline_keeps_fixed_task_deadline(farm_node):
+    node, sent = farm_node
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.update(r=node.peer_sudoku_solve(BOARD)),
+        daemon=True,
+    )
+    t0 = time.monotonic()
+    t.start()
+    assert wait_for(lambda: node.active_tasks, timeout=3.0)
+    (_row, _col, task_deadline) = next(iter(node.active_tasks.values()))
+    assert task_deadline == pytest.approx(t0 + TASK_DEADLINE_S, abs=0.5)
+    # unblock the farm: every worker "departs", so the master answers
+    # from its authoritative local engine
+    node.membership.total_peers = lambda: []
+    t.join(timeout=30)
+    assert got["r"] is not None
+
+
+# -- satellite: health piggyback + LOST-peer skip -----------------------------
+
+
+def _stats_msg(origin, health=None):
+    return wire.stats_msg(
+        origin, 0, 0, {"all": {"solved": 0, "validations": 0}, "nodes": []},
+        health=health,
+    )
+
+
+def test_stats_msg_health_key_optional():
+    assert "health" not in _stats_msg("127.0.0.1:9")
+    msg = _stats_msg("127.0.0.1:9", health="lost")
+    assert msg["health"] == "lost"
+    # trailing key: the reference prefix is byte-identical
+    base = json.dumps(_stats_msg("127.0.0.1:9"))
+    assert json.dumps(msg).startswith(base[:-1])
+
+
+def test_peer_health_ingress_and_expiry(engine):
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    node.handle_message(_stats_msg("127.0.0.1:9", health="degraded"))
+    assert node.peer_health.get("127.0.0.1:9") == "degraded"
+    # garbage states never enter the map (wire ingress rule)
+    node.handle_message(_stats_msg("127.0.0.1:8", health="zombie"))
+    assert node.peer_health.get("127.0.0.1:8") is None
+    # claims expire: stale "lost" cannot exclude a peer forever
+    node.peer_health.ttl_s = 0.05
+    node.handle_message(_stats_msg("127.0.0.1:9", health="lost"))
+    assert node.peer_health.is_lost("127.0.0.1:9")
+    time.sleep(0.1)
+    assert node.peer_health.get("127.0.0.1:9") is None
+    # departure forgets the claim
+    node.peer_health.ttl_s = 15.0
+    node.handle_message(_stats_msg("127.0.0.1:7", health="lost"))
+    node.membership.on_connect("127.0.0.1:7")
+    node.handle_message(wire.disconnect_msg("127.0.0.1:7"))
+    assert node.peer_health.get("127.0.0.1:7") is None
+
+
+def test_broadcast_stats_carries_supervisor_state(
+    supervised, monkeypatch
+):
+    engine, sup, inj = supervised
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    sent = []
+    monkeypatch.setattr(
+        node.membership, "neighbors", lambda: ["127.0.0.1:9"]
+    )
+    monkeypatch.setattr(
+        node, "send_to", lambda peer, msg: sent.append(msg)
+    )
+    node.broadcast_stats()
+    assert sent[-1]["health"] == "healthy"
+    inj.arm_fail_next(1)
+    engine.solve_one(BOARD)
+    node.broadcast_stats()
+    assert sent[-1]["health"] == "degraded"
+    inj.clear()
+    sup.probe()
+
+
+def test_farm_skips_lost_peers(engine, monkeypatch):
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    sent = []
+    monkeypatch.setattr(
+        node.membership,
+        "total_peers",
+        lambda: ["127.0.0.1:1", "127.0.0.1:2"],
+    )
+    monkeypatch.setattr(
+        node, "send_to", lambda peer, msg: sent.append((peer, msg))
+    )
+    node.peer_health.note("127.0.0.1:1", "lost")
+
+    # run the farm with a short deadline; only the healthy peer may see
+    # solve dispatches
+    def run():
+        try:
+            node.peer_sudoku_solve_info(
+                BOARD, deadline_s=time.monotonic() + 0.4
+            )
+        except DeadlineExceeded:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    solve_targets = {p for p, m in sent if m["type"] == "solve"}
+    assert solve_targets == {"127.0.0.1:2"}
+
+
+def test_farm_with_every_peer_lost_answers_from_local_engine(
+    engine, monkeypatch
+):
+    node = P2PNode("127.0.0.1", free_port(), engine=engine)
+    sent = []
+    monkeypatch.setattr(
+        node.membership, "total_peers", lambda: ["127.0.0.1:1"]
+    )
+    monkeypatch.setattr(
+        node, "send_to", lambda peer, msg: sent.append((peer, msg))
+    )
+    node.peer_health.note("127.0.0.1:1", "lost")
+    solution, info = node.peer_sudoku_solve_info(BOARD)
+    assert solution is not None and oracle_is_valid_solution(solution)
+    assert not any(m["type"] == "solve" for _p, m in sent)
+
+
+# -- satellite: admission capacity re-anchor ----------------------------------
+
+
+def test_window_rate_reanchor_drops_held_peak():
+    r = WindowRate(window_s=0.2)
+    t0 = 100.0
+    for k in range(50):
+        r.observe(t0 + k * 0.004)  # 250/s burst
+    assert r.rate(now=t0 + 0.2, frozen=True) > 100.0
+    r.reanchor()
+    assert r.rate(now=t0 + 0.2, frozen=True) == 0.0
+    # re-learns the new (slower) regime from scratch
+    for k in range(4):
+        r.observe(t0 + 1.0 + k * 0.1)
+    assert 0.0 < r.rate(now=t0 + 1.4, frozen=True) < 50.0
+
+
+def test_supervisor_transition_reanchors_admission(supervised):
+    engine, sup, inj = supervised
+    adm = AdmissionController(capacity=8)
+    sup.add_transition_callback(lambda _old, _new: adm.reanchor())
+    # build a completion-rate history the projection would trust
+    for _ in range(20):
+        assert adm.try_admit().admitted
+        adm.release()
+    assert adm.snapshot()["completion_rate_hz"] > 0.0
+    inj.arm_fail_next(1)
+    engine.solve_one(BOARD)  # HEALTHY -> DEGRADED fires the callback
+    snap = adm.snapshot()
+    assert snap["reanchors"] == 1
+    assert snap["completion_rate_hz"] == 0.0  # stale peak forgotten
+    inj.clear()
+    assert sup.probe() is True  # DEGRADED -> HEALTHY re-anchors again
+    assert adm.snapshot()["reanchors"] == 2
