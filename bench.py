@@ -33,6 +33,12 @@ Modes (BENCH_MODE env var):
     persistent-XLA-cache, AOT-artifact} on CPU (engine tiered warmup +
     compilecache/). Artifact benchmarks/coldstart_pr4.json; vs_baseline
     = warm-vs-cold first-solve speedup over the ≥3× acceptance bar.
+  obs-overhead — the tracing plane's cost proof (ISSUE 6): tracing-on vs
+    --no-obs aggregate puzzles/s under BENCH_OBS_CLIENTS (default 64)
+    closed-loop clients (acceptance: on ≥ 0.97× off), plus an injected
+    breaker-trip incident whose flight-recorder dump must carry the
+    poisoned request's span with per-stage timings. Artifact
+    benchmarks/obs_overhead_pr6.json.
 
 Modes are also selectable as ``python bench.py --mode <name>``.
 
@@ -1526,6 +1532,426 @@ def main_overload():
     )
 
 
+def main_obs_overhead():
+    """The tracing plane's cost proof + incident artifact (ISSUE 6).
+
+    Phase A — overhead A/B: TWO nodes boot side by side — the default
+    tracing-on stack and a ``--no-obs`` baseline — and BENCH_OBS_CLIENTS
+    (default 64) closed-loop keep-alive clients drive them in short
+    alternating windows (BENCH_OBS_WINDOWS pairs of BENCH_OBS_SECS,
+    defaults 24 x 2 s), flipping which arm goes first every pair.
+    Design notes, all measured on this class of shared host: available
+    CPU swings ~2x on a seconds timescale (cgroup burst/throttle
+    cycles), and the SECOND of two back-to-back windows loses up to 40%
+    regardless of arm — so windows are short, many, and order-balanced,
+    and the headline is the MEDIAN of per-pair on/off ratios. Driving
+    both nodes concurrently instead would be weather-free but measures
+    the wrong thing (two processes competing for the same cores punish
+    the heavier arm super-linearly — a co-residency scenario, not
+    "a traced node vs itself untraced"). The artifact also carries
+    ``cpu_us_per_request`` per arm from /proc/<pid> accounting — a
+    second view of the same claim (less weather-proof than it looks:
+    CPU-seconds stretch under frequency throttling, so it has read
+    +35..+135 us across runs against an isolated tracer cost of
+    ~14 us/request — microbenched — plus allocation/GC amortization).
+    Acceptance wants ≥0.97 (vs_baseline normalizes to it).
+
+    Phase B — incident: in-process engine + supervisor + flight recorder
+    with a POISONED bucket (utils/faults.EngineFaultInjector.corrupt):
+    one traced /solve-shaped request gets a silently-wrong device answer,
+    host verification catches it, the breaker trips DEGRADED, and the
+    flight recorder's incident dump must contain that very request's span
+    with per-stage timings (queue/coalesce/device/verify + fallback) —
+    the black box demonstrably answers "what was the node doing when it
+    went DEGRADED".
+
+    Artifact: benchmarks/obs_overhead_pr6.json (BENCH_OBS_OUT overrides).
+    Default platform cpu (same pooled-chip rule as farm/concurrent).
+    """
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    clients = int(os.environ.get("BENCH_OBS_CLIENTS", "64"))
+    secs = float(os.environ.get("BENCH_OBS_SECS", "2"))
+    windows = int(os.environ.get("BENCH_OBS_WINDOWS", "24"))
+    platform = os.environ.get("BENCH_PLATFORM", "cpu")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get(
+        "BENCH_OBS_OUT",
+        os.path.join(repo, "benchmarks", "obs_overhead_pr6.json"),
+    )
+    base_port = 18400 + os.getpid() % 700
+    PORT_ON, PORT_OFF = base_port, base_port + 2
+
+    hard = os.path.join(repo, "benchmarks", "corpus_9x9_hard_64.npz")
+    if os.path.exists(hard):
+        boards = np.load(hard)["boards"][:32]
+    else:
+        boards = generate_batch(32, 64, seed=20260802, unique=True)
+    bodies = [json.dumps({"sudoku": b.tolist()}).encode() for b in boards]
+
+    # Resource isolation, same rationale as --mode overload: on a shared
+    # small host an unpinned server + 64 generator threads find different
+    # GIL/scheduler equilibria per boot (measured: per-phase pps varying
+    # 2x with zero ambient load), which drowns a few-percent overhead
+    # A/B. One dedicated core per role makes phases repeatable.
+    cores = (
+        sorted(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity")
+        else []
+    )
+    node_prefix = []
+    if (
+        len(cores) >= 2
+        and platform == "cpu"
+        and os.environ.get("BENCH_OBS_NO_PIN") != "1"
+        and __import__("shutil").which("taskset") is not None
+    ):
+        node_prefix = ["taskset", "-c", str(cores[0])]
+        os.sched_setaffinity(0, set(cores[1:]))
+
+    import socket
+
+    requests_bytes = [
+        b"POST /solve HTTP/1.1\r\nHost: bench\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: %d\r\n\r\n%s" % (len(b), b)
+        for b in bodies
+    ]
+
+    class RawConn:
+        """Keep-alive raw-socket client (the main_concurrent shape: the
+        load generator must not out-cost the thing being measured)."""
+
+        def __init__(self, port, timeout=300.0):
+            self.port = port
+            self.timeout = timeout
+            self.sock = None
+            self.rf = None
+
+        def _connect(self):
+            self.sock = socket.create_connection(
+                ("127.0.0.1", self.port), timeout=self.timeout
+            )
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.rf = self.sock.makefile("rb", -1)
+
+        def close(self):
+            if self.sock is not None:
+                try:
+                    self.rf.close()
+                    self.sock.close()
+                except OSError:
+                    pass
+            self.sock = self.rf = None
+
+        def post(self, k):
+            if self.sock is None:
+                self._connect()
+            t0 = time.perf_counter()
+            self.sock.sendall(requests_bytes[k % len(requests_bytes)])
+            status_line = self.rf.readline(65537)
+            if not status_line:
+                raise OSError("server closed connection")
+            parts = status_line.split(None, 2)
+            clen, close = 0, False
+            while True:
+                h = self.rf.readline(65537)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                key, _, value = h.partition(b":")
+                key = key.strip().lower()
+                if key == b"content-length":
+                    clen = int(value)
+                elif key == b"connection":
+                    close = value.strip().lower() == b"close"
+            raw = self.rf.read(clen)
+            dt = (time.perf_counter() - t0) * 1e3
+            if close:
+                self.close()
+            assert parts[1] == b"200", (
+                f"/solve answered {parts[1]!r}: {raw[:120]!r}"
+            )
+            return dt
+
+    def scrape(port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as r:
+            return r.headers, r.read()
+
+    def boot_node(http_port, udp_port, extra_flags):
+        return subprocess.Popen(
+            node_prefix
+            + [
+                sys.executable, os.path.join(repo, "node.py"),
+                "-p", str(http_port), "-s", str(udp_port), "-h", "0",
+                "--serving-stats", "--metrics", "--buckets", "1,8,64",
+            ]
+            + (["--coalesce-max-batch", "8"] if platform == "cpu" else [])
+            + (["--platform", platform] if platform else [])
+            + extra_flags,
+            cwd=repo,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    def wait_ready(proc, port, deadline):
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node exited rc={proc.returncode} before serving"
+                )
+            try:
+                scrape(port, "/stats")
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise RuntimeError("node did not come up") from None
+                time.sleep(0.5)
+        while time.time() < deadline:
+            _h, raw = scrape(port, "/metrics")
+            eng_m = json.loads(raw).get("engine", {})
+            if eng_m.get("fully_warmed", eng_m.get("warmed")):
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("engine warmup did not finish")
+        c = RawConn(port)
+        fast = 0
+        while fast < 2 and time.time() < deadline:
+            fast = fast + 1 if c.post(0) < 500 else 0
+        c.close()
+
+    def drive(port):
+        """One closed-loop measurement window against ``port``; clients
+        keep their connections across windows (conns dict below) so a
+        window measures serving, not reconnect storms."""
+        stop = time.perf_counter() + secs
+        counts, failures = [], []
+        lock = threading.Lock()
+
+        def client(i):
+            conn, n, k = conns.setdefault((port, i), RawConn(port)), 0, i
+            try:
+                while time.perf_counter() < stop:
+                    try:
+                        conn.post(k)
+                        n += 1
+                    except AssertionError as e:
+                        failures.append(f"client {i}: {e}")
+                        return
+                    except OSError:
+                        conn.close()
+                    k += clients
+            finally:
+                with lock:
+                    counts.append(n)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not failures, failures[:3]
+        return sum(counts), wall
+
+    def cpu_s(pid):
+        """The node process's accumulated CPU seconds (utime+stime)."""
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().split()
+        return (int(parts[13]) + int(parts[14])) / os.sysconf("SC_CLK_TCK")
+
+    conns = {}
+    phases = {"off": [], "on": []}
+    totals = {"off": [0, 0.0], "on": [0, 0.0]}
+    cpu = {"off": [0.0, 0], "on": [0.0, 0]}  # cpu seconds, requests
+    timing_sample = None
+    obs_snapshot = None
+    proc_on = boot_node(PORT_ON, PORT_ON - 1000, [])
+    proc_off = boot_node(PORT_OFF, PORT_OFF - 1000, ["--no-obs"])
+    arm_proc = {"on": proc_on, "off": proc_off}
+    try:
+        deadline = time.time() + 240
+        wait_ready(proc_on, PORT_ON, deadline)
+        wait_ready(proc_off, PORT_OFF, deadline)
+        for _w in range(max(1, windows)):
+            pair = [("off", PORT_OFF), ("on", PORT_ON)]
+            if _w % 2:
+                # order-balance: consecutive windows are NOT exchangeable
+                # on a small host (burst credits / throttle decay within
+                # a pair), and a fixed order turns that decay into fake
+                # arm overhead (see docstring)
+                pair.reverse()
+            for arm, port in pair:
+                c0 = cpu_s(arm_proc[arm].pid)
+                n, wall = drive(port)
+                cpu[arm][0] += cpu_s(arm_proc[arm].pid) - c0
+                cpu[arm][1] += n
+                phases[arm].append(round(n / wall, 1))
+                totals[arm][0] += n
+                totals[arm][1] += wall
+        # one opt-in X-Timing request proves the header end to end
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{PORT_ON}/solve",
+            data=bodies[0],
+            headers={"X-Timing": "1", "X-Request-Id": "bench-obs-probe"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            timing_sample = json.loads(r.headers["X-Timing"])
+            assert r.headers["X-Request-Id"] == "bench-obs-probe"
+        _h, raw = scrape(PORT_ON, "/metrics")
+        obs_snapshot = json.loads(raw).get("obs", {})
+    finally:
+        for c in conns.values():
+            c.close()
+        for proc in (proc_on, proc_off):
+            proc.terminate()
+        for proc in (proc_on, proc_off):
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+    on_pps = totals["on"][0] / totals["on"][1]
+    off_pps = totals["off"][0] / totals["off"][1]
+    ratio = on_pps / off_pps if off_pps else 0.0
+    cpu_us = {
+        arm: round(c / n * 1e6, 1) if n else None
+        for arm, (c, n) in cpu.items()
+    }
+    # per-window paired ratios (each on-window against the immediately
+    # preceding off-window — same weather) plus the off-arm's own
+    # spread: the reader's noise gauge for a shared box
+    paired = sorted(
+        round(o / f, 4) if f else 0.0
+        for o, f in zip(phases["on"], phases["off"])
+    )
+    median_paired = paired[len(paired) // 2] if paired else 0.0
+    off_spread = (
+        round(max(phases["off"]) / min(phases["off"]), 3)
+        if min(phases["off"]) > 0
+        else None
+    )
+
+    # -- phase B: the injected breaker-trip incident -----------------------
+    import jax
+
+    jax.config.update("jax_platforms", platform or "cpu")
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.obs import FlightRecorder, Tracer
+    from sudoku_solver_distributed_tpu.serving.health import EngineSupervisor
+    from sudoku_solver_distributed_tpu.utils import EngineFaultInjector
+
+    dump_dir = tempfile.mkdtemp(prefix="obs_incident_")
+    eng = SolverEngine(buckets=(1, 4), coalesce=True)
+    eng.warmup()
+    flight = FlightRecorder(dump_dir=dump_dir, incident_delay_s=0.2)
+    tracer = Tracer(recorder=flight)
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(eng, probe_interval_s=600.0)
+    flight.attach_supervisor(sup)
+    incident = {}
+    try:
+        board = [[0] * 9 for _ in range(9)]
+        board[0][0] = 5
+        # warm span first (healthy), then poison the width-1 program:
+        # the next traced request's device answer is silently wrong, host
+        # verification catches it, breaker trips, flight record dumps
+        t = tracer.start("/solve")
+        eng.solve_one_supervised(board)
+        tracer.finish(t, 200)
+        inj.poison_bucket(1)
+        t = tracer.start("/solve")
+        sol, info = eng.solve_one_supervised(board)
+        tracer.finish(t, 200, degraded=bool(info.get("degraded")))
+        assert sol is not None, "fallback failed to answer"
+        deadline = time.time() + 10
+        while flight.stats()["dumps"] == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        record_path = flight.stats()["last_dump_path"]
+        assert record_path, "incident dump never landed"
+        with open(record_path) as f:
+            payload = json.load(f)
+        poisoned = [s for s in payload["spans"] if s.get("fallback")]
+        assert poisoned, "poisoned request's span missing from the dump"
+        span = poisoned[-1]
+        for k in ("queue_ms", "coalesce_ms", "device_ms", "verify_ms"):
+            assert k in span, f"span missing stage {k}"
+        incident = {
+            "reason": payload["reason"],
+            "spans_in_dump": len(payload["spans"]),
+            "events": payload["events"],
+            "poisoned_span": span,
+        }
+    finally:
+        sup.close()
+        eng.fault_injector = None
+        eng.close()
+
+    record = {
+        "metric": f"obs_overhead_throughput_ratio_{clients}c_9x9",
+        # median paired-window ratio (see docstring: robust to episodic
+        # single-window scheduler stalls; the aggregate rides below)
+        "value": round(median_paired, 4),
+        "unit": "x_tracing_on_vs_off",
+        # acceptance bar: tracing-on >= 0.97x tracing-off (>=1.0 meets it)
+        "vs_baseline": round(median_paired / 0.97, 3),
+        "aggregate_ratio": round(ratio, 4),
+        "clients": clients,
+        "window_secs": secs,
+        "windows": windows,
+        "platform": platform,
+        "tracing_on_pps": round(on_pps, 1),
+        "tracing_off_pps": round(off_pps, 1),
+        "phases": phases,
+        "paired_ratios_sorted": paired,
+        "median_paired_ratio": median_paired,
+        "off_phase_spread": off_spread,
+        # the weather-resistant view: server CPU per request per arm
+        # (/proc accounting) — the tracing plane's cost as CPU, immune to
+        # the throughput lottery a small shared host plays
+        "cpu_us_per_request": cpu_us,
+        "cpu_overhead_ratio": (
+            round(cpu_us["on"] / cpu_us["off"], 4)
+            if cpu_us["on"] and cpu_us["off"]
+            else None
+        ),
+        "timing_header_sample": timing_sample,
+        "obs_snapshot": obs_snapshot,
+        "incident": incident,
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    headline = {k: record[k] for k in ("metric", "value", "unit", "vs_baseline")}
+    print(json.dumps(headline))
+    print(
+        f"# obs-overhead: on={on_pps:.1f}pps off={off_pps:.1f}pps "
+        f"ratio={ratio:.4f} median_paired={median_paired} "
+        f"off_spread={off_spread} cpu_us/req={cpu_us} clients={clients} "
+        f"windows={windows}x{secs}s "
+        f"| incident: {incident.get('reason')} "
+        f"spans={incident.get('spans_in_dump')} "
+        f"poisoned_span stages="
+        f"{ {k: incident['poisoned_span'][k] for k in ('queue_ms', 'coalesce_ms', 'device_ms', 'verify_ms', 'fallback_ms')} if incident else None} "
+        f"| artifact: {out_path}",
+        file=sys.stderr,
+    )
+
+
 def main_coldstart_child():
     """One cold-start probe in a FRESH process (jit caches are per-process;
     only a child can measure a cold start). Builds a SolverEngine with the
@@ -1980,7 +2406,8 @@ if __name__ == "__main__":
         idx = argv.index("--mode") + 1
         if idx >= len(argv):
             sys.exit("bench.py: --mode needs a value "
-                     "(throughput|latency|farm|concurrent|overload|coldstart)")
+                     "(throughput|latency|farm|concurrent|overload|"
+                     "coldstart|obs-overhead)")
         mode = argv[idx]
     if mode == "latency":
         main_latency()
@@ -1994,9 +2421,12 @@ if __name__ == "__main__":
         main_coldstart()
     elif mode == "coldstart-child":
         main_coldstart_child()
+    elif mode == "obs-overhead":
+        main_obs_overhead()
     elif mode != "throughput":
         sys.exit(f"bench.py: unknown mode {mode!r} "
-                 f"(throughput|latency|farm|concurrent|overload|coldstart)")
+                 f"(throughput|latency|farm|concurrent|overload|coldstart|"
+                 f"obs-overhead)")
     elif os.environ.get("BENCH_CHILD") == "1":
         main()
     else:

@@ -25,6 +25,7 @@ read forever after. ``warmed`` now means "tier-0 warm" (servable);
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -36,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .obs.trace import current_trace
 from .ops import BoardSpec, SPEC_9, solve_batch
 from .ops import solver as _solver
 from .ops.config import SERVING_CONFIG
@@ -309,6 +311,17 @@ class SolverEngine:
         # tracing instead of crashing (_profile_mutex)
         self.profile_dir: Optional[str] = None
         self._profile_mutex = threading.Lock()
+        # jax.profiler hook (ISSUE 6 satellite, CLI --device-trace-dir):
+        # when armed, ONE warmup pass and the first N supervised device
+        # calls each leave an XLA trace artifact under this directory —
+        # a TPU window run produces profiler evidence with no code edits.
+        # Counters ride warm_info() so the capture is observable from
+        # /metrics. Mutations under _warm_lock; the capture itself shares
+        # _profile_mutex with --profile-dir (one active trace per process).
+        self.device_trace_dir: Optional[str] = None
+        self._device_trace_budget = 0
+        self._device_trace_captured = 0
+        self._warmup_trace_done = False
         self._lock = threading.Lock()
         # cumulative engine effort, the analog of the reference's
         # `validations` counter (node.py:87): one unit per analysis sweep per
@@ -564,6 +577,16 @@ class SolverEngine:
         if self.supervisor is not None:
             self.supervisor.close()
 
+    def arm_device_trace(self, log_dir: str, calls: int = 4) -> None:
+        """Arm the ``jax.profiler`` capture hook (CLI --device-trace-dir):
+        the next warmup pass and the first ``calls`` supervised device
+        dispatches each record an XLA trace into ``log_dir``. Idempotent
+        re-arm: a later call resets the budget (the warmup capture stays
+        once-only per process — one warmup is one artifact)."""
+        with self._warm_lock:
+            self.device_trace_dir = log_dir
+            self._device_trace_budget = max(0, int(calls))
+
     def health(self) -> dict:
         """Operator-facing engine health, served under /metrics "engine".
 
@@ -751,7 +774,32 @@ class SolverEngine:
                 boards[0], (bucket - n, *boards.shape[1:])
             )
             boards = np.concatenate([boards, pad], axis=0)
-        if self.profile_dir is not None and self._profile_mutex.acquire(
+        if (
+            self._device_trace_budget > 0
+            and self.device_trace_dir is not None
+            and self._profile_mutex.acquire(blocking=False)
+        ):
+            # --device-trace-dir capture (ISSUE 6 satellite): spend one
+            # budgeted supervised-call capture. Budget re-checked under
+            # _warm_lock — the lock-free pre-check above only gates the
+            # mutex acquire, two racing dispatches must not both spend
+            # the last slot.
+            try:
+                with self._warm_lock:
+                    take = self._device_trace_budget > 0
+                    if take:
+                        self._device_trace_budget -= 1
+                        self._device_trace_captured += 1
+                if take:
+                    with device_trace(self.device_trace_dir), annotate(
+                        f"supervised_call_b{bucket}"
+                    ):
+                        packed = self._solve(self._device_batch(boards))
+                else:
+                    packed = self._solve(self._device_batch(boards))
+            finally:
+                self._profile_mutex.release()
+        elif self.profile_dir is not None and self._profile_mutex.acquire(
             blocking=False
         ):
             try:
@@ -849,8 +897,21 @@ class SolverEngine:
 
         Synchronous composition of ``_dispatch_padded`` + ``_finalize_padded``
         (the coalescer runs the two phases on separate threads instead).
+        Runs in the requesting thread, so the caller's request span (when
+        one is open — the --no-coalesce /solve path, /solve_batch chunks)
+        accumulates the call's wall time as device stage here; coalesced
+        requests are stamped by the coalescer's threads instead.
         """
-        return self._finalize_padded(*self._dispatch_padded(boards))
+        tr = current_trace()
+        if tr is None:
+            return self._finalize_padded(*self._dispatch_padded(boards))
+        t0 = time.monotonic()
+        try:
+            rows = self._finalize_padded(*self._dispatch_padded(boards))
+        finally:
+            tr.mark("device", time.monotonic() - t0)
+        tr.bucket = self._bucket_for(boards.shape[0])
+        return rows
 
     def _account_coalesced(self, rows: np.ndarray) -> None:
         """Fold one coalesced batch's work into the engine counters — the
@@ -912,9 +973,30 @@ class SolverEngine:
         deadline = None if budget_s is None else time.monotonic() + budget_s
         with self._warm_lock:
             self._warmup_started = True
-        for b in self._tier0_buckets():
-            self._warm_bucket(b)
-        self._warm_probe_programs()
+            # --device-trace-dir capture (ISSUE 6 satellite): the first
+            # warmup pass records its tier-0 compiles+solves as an XLA
+            # trace — once per process, and only if no other trace is
+            # live (the profiler allows one active trace per process)
+            trace_warm = (
+                self.device_trace_dir is not None
+                and not self._warmup_trace_done
+            )
+        trace_warm = trace_warm and self._profile_mutex.acquire(
+            blocking=False
+        )
+        try:
+            with contextlib.ExitStack() as stack:
+                if trace_warm:
+                    with self._warm_lock:
+                        self._warmup_trace_done = True
+                    stack.enter_context(device_trace(self.device_trace_dir))
+                    stack.enter_context(annotate("warmup_tier0"))
+                for b in self._tier0_buckets():
+                    self._warm_bucket(b)
+                self._warm_probe_programs()
+        finally:
+            if trace_warm:
+                self._profile_mutex.release()
         with self._warm_lock:
             self.warmed = True
         if background:
@@ -1215,6 +1297,16 @@ class SolverEngine:
                 "skipped": list(self._warm_skipped),
                 "programs": len(self._programs),
             }
+            if self.device_trace_dir is not None:
+                # the --device-trace-dir capture state (ISSUE 6 satellite):
+                # how many XLA trace artifacts this process has recorded
+                # and how many supervised-call captures remain armed
+                out["device_trace"] = {
+                    "dir": self.device_trace_dir,
+                    "warmup_traced": self._warmup_trace_done,
+                    "captured_calls": self._device_trace_captured,
+                    "calls_remaining": self._device_trace_budget,
+                }
         if self._aot_store is not None:
             out["aot"] = self._aot_store.stats()
         return out
@@ -1556,21 +1648,31 @@ class SolverEngine:
                 "fallback"
             )
             return sup.fallback_solve(arr)
-        if solution is not None and not sup.check_solution(arr, solution):
-            # device call "succeeded" but the answer is wrong: the
-            # poisoned-program failure mode — never serve it
-            logger.error(
-                "device answer failed host-side verification — "
-                "poisoned program? answering from the fallback"
-            )
-            sup.record_failure(None, "bad-result")
-            return sup.fallback_solve(arr)
+        tr = current_trace()
+        if solution is not None:
+            t_v = time.monotonic()
+            ok = sup.check_solution(arr, solution)
+            if tr is not None:
+                # the host-side verification stage of this request's span
+                tr.mark("verify", time.monotonic() - t_v)
+            if not ok:
+                # device call "succeeded" but the answer is wrong: the
+                # poisoned-program failure mode — never serve it
+                logger.error(
+                    "device answer failed host-side verification — "
+                    "poisoned program? answering from the fallback"
+                )
+                sup.record_failure(None, "bad-result")
+                return sup.fallback_solve(arr)
         if solution is None and not info.get("capped"):
             # device claims PROVEN unsatisfiable (capped answers claim
             # only "not finished" and are exempt): cross-check — a
             # poisoned program clearing the solved flag is as wrong as
             # one corrupting the grid, and must trip the breaker too
+            t_v = time.monotonic()
             alt, alt_info = sup.verify_unsat(arr)
+            if tr is not None:
+                tr.mark("verify", time.monotonic() - t_v)
             if alt is not None:
                 sup.record_failure(None, "bad-result")
                 return alt, alt_info

@@ -55,6 +55,26 @@ Extensions (defaults preserve reference behavior):
                 coordinator ("host:port") so the engine's mesh spans a pod
                 slice; the P2P/HTTP control plane is unchanged (SURVEY.md §5
                 distributed-backend row)
+  --no-obs      disable the request-lifecycle tracing plane (obs/): span
+                recording across admission→coalesce→device→verify, the
+                X-Timing breakdown, the /metrics obs block + stage
+                histograms, and the incident flight recorder (its HTTP
+                trigger 404s). X-Request-Id echo and the /metrics.prom
+                rendering of the remaining blocks stay — ids correlate
+                retries whether or not spans are recorded. ON by default:
+                the plane costs ~15 µs/request (bench.py --mode
+                obs-overhead holds the throughput A/B) and is the node's
+                black box
+  --flightrecord-dir
+                where incident flight-recorder dumps land (breaker trip,
+                shed storm, SIGUSR2, POST /debug/flightrecord); env default
+                SUDOKU_FLIGHTRECORD_DIR, else ./flightrecords
+  --device-trace-dir / --device-trace-calls
+                jax.profiler hook: record ONE warmup pass and the first N
+                supervised device calls (default 4) as XLA trace artifacts
+                into the dir — a TPU window run leaves profiler evidence
+                with no code edits (capture state rides warm_info() on
+                /metrics)
   --compile-cache-dir / --warmup-budget-s
                 cold-start compiler plane (compilecache/, engine.warmup):
                 the cache dir roots jax's persistent XLA cache plus the
@@ -73,6 +93,7 @@ import argparse
 import logging
 import os
 import threading
+import time
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -252,6 +273,36 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None, help="jax.profiler trace output dir"
     )
     parser.add_argument(
+        "--no-obs",
+        action="store_true",
+        help="disable the request-lifecycle tracing plane (obs/): span "
+        "recording, the X-Timing breakdown, the /metrics obs block and "
+        "stage histograms, and the incident flight recorder (X-Request-Id "
+        "echo stays — ids correlate retries regardless). On by default "
+        "(bench.py --mode obs-overhead holds the cost claim)",
+    )
+    parser.add_argument(
+        "--flightrecord-dir",
+        default=os.environ.get("SUDOKU_FLIGHTRECORD_DIR") or "flightrecords",
+        help="directory incident flight-recorder dumps are written to "
+        "(breaker trip, shed storm, SIGUSR2, POST /debug/flightrecord). "
+        "Env default: SUDOKU_FLIGHTRECORD_DIR",
+    )
+    parser.add_argument(
+        "--device-trace-dir",
+        default=None,
+        help="record ONE warmup pass and the first N supervised device "
+        "calls (--device-trace-calls) as jax.profiler/XLA trace artifacts "
+        "into this dir; capture state rides warm_info() at /metrics",
+    )
+    parser.add_argument(
+        "--device-trace-calls",
+        type=int,
+        default=4,
+        help="with --device-trace-dir: how many supervised device calls "
+        "to capture after warmup (default 4)",
+    )
+    parser.add_argument(
         "--failure-timeout",
         type=float,
         default=5.0,
@@ -400,6 +451,20 @@ def main(argv=None) -> None:
             engine.frontier_loop = serving_loop
     from ..utils.profiling import RequestMetrics
 
+    # request-lifecycle tracing plane (obs/, ISSUE 6): default ON — the
+    # spans, the flight recorder, and the Prometheus stage histograms
+    # are the node's black box, and the plane's cost is the feature's
+    # own claim (bench.py --mode obs-overhead). --no-obs is the overhead
+    # A/B's baseline: no span recording anywhere (X-Request-Id echo is
+    # unconditional on both arms — retries must correlate regardless).
+    tracer = None
+    flight = None
+    if not args.no_obs:
+        from ..obs import FlightRecorder, Tracer
+
+        flight = FlightRecorder(dump_dir=args.flightrecord_dir)
+        tracer = Tracer(recorder=flight)
+
     admission = None
     if args.admission_capacity > 0 or args.default_deadline_ms > 0:
         from ..serving import AdmissionController
@@ -425,6 +490,10 @@ def main(argv=None) -> None:
             supervisor.add_transition_callback(
                 lambda _old, _new: admission.reanchor()
             )
+        if flight is not None:
+            # breaker trips / watchdog hangs land in the event ring and
+            # dump the black box (obs/flight.py)
+            flight.attach_supervisor(supervisor)
     node = P2PNode(
         args.host,
         args.s,
@@ -433,12 +502,36 @@ def main(argv=None) -> None:
         engine=engine,
         mesh_peer_count=args.mesh_peers,
         failure_timeout=args.failure_timeout,
-        metrics=RequestMetrics(),
+        # ONE recording machinery: with the tracing plane on, the node's
+        # per-route recorder IS the tracer's (obs/histo.RouteMetrics)
+        metrics=tracer.routes if tracer is not None else RequestMetrics(),
         serialize_solves=args.seed_serving,
         admission=admission,
     )
+    node.tracer = tracer
+    node.flight = flight
+    if flight is not None:
+        import signal
+
+        try:
+            # operator dump trigger: kill -USR2 <pid> writes the flight
+            # record without touching the HTTP surface
+            signal.signal(
+                signal.SIGUSR2,
+                lambda _sig, _frm: flight.dump(reason="sigusr2"),
+            )
+        except (ValueError, AttributeError, OSError):
+            # non-main thread (embedding) or a platform without SIGUSR2:
+            # the HTTP trigger still works
+            pass
     if args.profile_dir:
         node.engine.profile_dir = args.profile_dir
+    if args.device_trace_dir:
+        # jax.profiler hook (ISSUE 6 satellite): warmup + the first N
+        # supervised device calls leave XLA trace artifacts
+        engine.arm_device_trace(
+            args.device_trace_dir, calls=args.device_trace_calls
+        )
     if not args.no_warmup:
         # pre-compile the serving buckets so the first /solve is warm
         # (p50 <5 ms contract, engine.SolverEngine.warmup). Tiered: the
@@ -449,6 +542,30 @@ def main(argv=None) -> None:
             kwargs={"budget_s": args.warmup_budget_s or None},
             daemon=True,
         ).start()
+
+    def _freeze_after_warmup():
+        # Serving-process GC hygiene: once the ladder is warm the heap is
+        # huge (jax + compiled programs) and effectively immortal, yet
+        # every ~7k request-path container allocations would drag a full
+        # collection over it. Freezing the post-warmup heap moves it to
+        # the permanent generation, so steady-state GC only scans the
+        # young per-request objects — this keeps request-path features
+        # (coalescer futures, tracing spans) allocation-cheap instead of
+        # GC-amplified.
+        import gc
+
+        if not args.no_warmup:
+            # wait for the ladder (bounded: a budget-cut warmup never
+            # flips fully_warmed — freeze what exists by the horizon)
+            deadline = time.monotonic() + 600.0
+            while (
+                not engine.fully_warmed and time.monotonic() < deadline
+            ):
+                time.sleep(1.0)
+        gc.collect()
+        gc.freeze()
+
+    threading.Thread(target=_freeze_after_warmup, daemon=True).start()
 
     httpd = make_http_server(
         node, args.host, args.p,

@@ -275,6 +275,12 @@ class FastHTTPServer:
             headers.get(b"connection", b"").lower() == b"close"
         )
 
+        # per-request observability context (ISSUE 6): every response
+        # carries X-Request-Id (client-echoed or minted); X-Timing is the
+        # client's opt-in to the span's stage breakdown
+        req_id = http_api.ensure_request_id(headers.get(b"x-request-id"))
+        want_timing = b"x-timing" in headers
+
         # body framing (mirrors the stock handler's _read_body contract)
         te = headers.get(b"transfer-encoding", b"").lower()
         try:
@@ -308,21 +314,50 @@ class FastHTTPServer:
             path_s = path.decode("latin-1")
             if path_s in ("/solve", "/solve_batch"):
                 self._record(path_s, t0, error=True)
-            self._reply(conn, 400, {"error": "Invalid request"}, close=True)
+            self._reply(
+                conn, 400, {"error": "Invalid request"}, close=True,
+                request_id=req_id,
+            )
             return False
 
-        status, payload, close_after, degraded = self._route(
-            method,
-            path.decode("latin-1"),
-            body,
-            t0,
-            deadline_ms=http_api._parse_deadline_ms(
-                headers.get(b"x-deadline-ms")
-            ),
+        path_s = path.decode("latin-1")
+        # open the request span at ingress for the traced routes; the
+        # route core runs inside it (the coalescer picks the span up from
+        # the thread-local at submit — obs/trace.py)
+        trace = None
+        if method == b"POST" and (
+            path_s == "/solve"
+            or (path_s == "/solve_batch" and self.expose_batch)
+        ):
+            trace = http_api.start_trace(self.p2p_node, path_s, req_id)
+        try:
+            status, payload, close_after, degraded = self._route(
+                method,
+                path_s,
+                body,
+                t0,
+                deadline_ms=http_api._parse_deadline_ms(
+                    headers.get(b"x-deadline-ms")
+                ),
+            )
+        except BaseException:
+            # a route-core crash (the worker-pool catch-all drops the
+            # connection) must still CLOSE the span: workers are reused,
+            # so a leaked thread-local would attach this dead request's
+            # trace to the next request on this thread — and the crashed
+            # request is exactly the span an incident dump needs
+            http_api.finish_trace(self.p2p_node, trace, 500)
+            raise
+        record = http_api.finish_trace(
+            self.p2p_node, trace, status, degraded=degraded
         )
         self._reply(
             conn, status, payload, close=close or close_after,
             degraded=degraded,
+            request_id=req_id,
+            timing=http_api.timing_header_value(record)
+            if record is not None and want_timing
+            else None,
         )
         return not (close or close_after)
 
@@ -352,6 +387,12 @@ class FastHTTPServer:
                 )
                 self._record("/solve_batch", t0, error=error)
                 return status, payload, False, False
+            if (
+                path == "/debug/flightrecord"
+                and getattr(node, "flight", None) is not None
+            ):
+                status, payload, _error = http_api.flightrecord_route(node)
+                return status, payload, False, False
             # unknown POST path: the stock handler never reads these
             # bodies and must close; this transport already consumed the
             # body, but it keeps the same observable contract
@@ -368,6 +409,10 @@ class FastHTTPServer:
                 return 200, node.network_view(), False, False
             if path == "/metrics" and self.expose_metrics:
                 return 200, http_api.metrics_payload(node), False, False
+            if path in http_api.PROM_PATHS and self.expose_metrics:
+                # Prometheus exposition — the shared core renders it, so
+                # the bytes match the stock transport's exactly
+                return 200, http_api.metrics_prom_payload(node), False, False
             if path == "/healthz":
                 return 200, http_api.healthz_payload(node), False, False
             if path == "/readyz":
@@ -383,26 +428,40 @@ class FastHTTPServer:
     # -- response ----------------------------------------------------------
     @staticmethod
     def _reply(
-        conn, status: int, payload, *, close: bool, degraded: bool = False
+        conn, status: int, payload, *, close: bool, degraded: bool = False,
+        request_id=None, timing=None,
     ) -> None:
-        body = json.dumps(payload).encode()
+        if isinstance(payload, bytes):
+            # pre-rendered non-JSON body (the Prometheus exposition)
+            body = payload
+            ctype = http_api.PROM_CONTENT_TYPE.encode()
+        else:
+            body = json.dumps(payload).encode()
+            ctype = b"application/json"
         extra = b"Connection: close\r\n" if close else b""
         if degraded:
             # fallback-served answer marker; body stays byte-identical
             # (see http_api.SudokuHTTPHandler._send_response)
             extra = b"X-Degraded: true\r\n" + extra
+        if timing is not None:
+            # the opt-in span breakdown (client sent X-Timing)
+            extra = b"X-Timing: %s\r\n%s" % (timing.encode(), extra)
+        if request_id is not None:
+            # every response correlates (ensure_request_id sanitized it)
+            extra = b"X-Request-Id: %s\r\n%s" % (request_id.encode(), extra)
         if status == 429:
             retry = http_api.retry_after_header(payload)
             if retry is not None:
                 extra = b"Retry-After: %s\r\n%s" % (retry.encode(), extra)
         head = (
             b"HTTP/1.1 %d %s\r\n"
-            b"Content-type: application/json\r\n"
+            b"Content-type: %s\r\n"
             b"Content-Length: %d\r\n"
             b"%s\r\n"
             % (
                 status,
                 _REASONS.get(status, b"Unknown"),
+                ctype,
                 len(body),
                 extra,
             )

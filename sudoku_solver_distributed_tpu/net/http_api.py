@@ -21,6 +21,10 @@ import logging
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+# the exposition content type is defined by the renderer — ONE site
+from ..obs.prom import CONTENT_TYPE as PROM_CONTENT_TYPE
+from ..obs.trace import new_request_id, valid_request_id
+
 logger = logging.getLogger(__name__)
 
 
@@ -65,6 +69,59 @@ def record_route(
     m = getattr(p2p_node, "metrics", None)
     if m is not None:
         m.record(route, time.perf_counter() - t0, error=error, shed=shed)
+
+
+def ensure_request_id(raw) -> str:
+    """The response's ``X-Request-Id``: the client's own id when it sent
+    a well-formed one (so retries across replicas correlate), else a
+    fresh 16-hex id. Every response on both transports carries it —
+    including 404s, 429 sheds, and degraded answers — because the replies
+    that went WRONG are exactly the ones an operator needs to find again
+    in the flight record."""
+    return valid_request_id(raw) or new_request_id()
+
+
+def start_trace(p2p_node, route: str, request_id: str):
+    """Open a request-lifecycle span (obs/trace.py) when the node carries
+    a tracer; None otherwise — both transports call this unconditionally
+    at ingress for the traced routes (/solve, /solve_batch)."""
+    tracer = getattr(p2p_node, "tracer", None)
+    if tracer is None:
+        return None
+    return tracer.start(route, trace_id=request_id)
+
+
+def finish_trace(p2p_node, trace, status: int, degraded: bool = False):
+    """Close a span; returns the finished record (the ``X-Timing`` header
+    source) or None. Tolerates trace=None so call sites stay branch-free."""
+    if trace is None:
+        return None
+    tracer = getattr(p2p_node, "tracer", None)
+    if tracer is None:
+        return None
+    return tracer.finish(trace, status, degraded=degraded)
+
+
+def timing_header_value(record: dict) -> str:
+    """The opt-in ``X-Timing`` response header (sent when the request
+    carried an ``X-Timing`` header): the span's stage breakdown as
+    compact JSON — where this request's milliseconds went."""
+    return json.dumps(
+        {
+            "total_ms": record["total_ms"],
+            "queue_ms": record["queue_ms"],
+            "coalesce_ms": record["coalesce_ms"],
+            "device_ms": record["device_ms"],
+            "verify_ms": record["verify_ms"],
+            "fallback_ms": record["fallback_ms"],
+            "bucket": record["bucket"],
+            "batch_id": record["batch_id"],
+            "degraded": record["degraded"],
+            "fallback": record["fallback"],
+            "farmed": record["farmed"],
+        },
+        separators=(",", ":"),
+    )
 
 
 def _parse_deadline_ms(raw):
@@ -320,7 +377,59 @@ def metrics_payload(p2p_node):
         faults["engine"] = eng_inj.counts()
     if faults:
         body["faults"] = faults
+    # the request-lifecycle tracing plane (obs/): span counters + per-
+    # stage latency summaries, and the flight recorder's ring state
+    tracer = getattr(p2p_node, "tracer", None)
+    if tracer is not None:
+        body["obs"] = tracer.snapshot()
+    flight = getattr(p2p_node, "flight", None)
+    if flight is not None:
+        body.setdefault("obs", {})["flight"] = flight.stats()
     return body
+
+
+# the two Prometheus spellings of the /metrics surface, matched EXACTLY
+# (no general query parsing: every other route's unknown-path 404 surface
+# stays byte-identical to the reference)
+PROM_PATHS = ("/metrics.prom", "/metrics?format=prom")
+
+
+def metrics_prom_payload(p2p_node) -> bytes:
+    """``GET /metrics.prom`` / ``GET /metrics?format=prom``: the SAME
+    dict the JSON body serializes, rendered as Prometheus text
+    (obs/prom.py) plus the tracer's stage histograms as real histogram
+    families. One shared core → byte-identical on both transports."""
+    from ..obs.prom import render
+
+    body = metrics_payload(p2p_node)
+    tracer = getattr(p2p_node, "tracer", None)
+    histograms = tracer.stages.histograms() if tracer is not None else None
+    return render(body, histograms).encode()
+
+
+def flightrecord_route(p2p_node):
+    """POST /debug/flightrecord: operator-triggered flight-recorder dump
+    (obs/flight.py — the same black box the breaker-trip/shed-storm/
+    SIGUSR2 triggers write). Returns (status, payload, error): a summary
+    plus the dump path when the recorder has a dump dir, else the whole
+    record inline (a dir-less node still answers the incident question).
+    404 on nodes without a recorder — the route does not exist there,
+    exactly like the other opt-in surfaces."""
+    flight = getattr(p2p_node, "flight", None)
+    if flight is None:
+        return 404, {"error": "Invalid endpoint"}, True
+    out = flight.dump(reason="http")
+    body = {
+        "dumped": True,
+        "reason": out["reason"],
+        "seq": out["seq"],
+        "path": out["path"],
+        "spans": out["spans"],
+        "events": out["events"],
+    }
+    if out["path"] is None:
+        body["record"] = out["payload"]
+    return 200, body, False
 
 
 class SudokuHTTPHandler(BaseHTTPRequestHandler):
@@ -344,14 +453,37 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
     #                         the reference {"all","nodes"} body exact
     MAX_BATCH = MAX_BATCH
     MAX_BATCH_BYTES = MAX_BATCH_BYTES
+    _req_id = None          # per-request id, set by _begin_request
+    _want_timing = False    # client sent X-Timing: opt into the breakdown
+
+    def _begin_request(self) -> None:
+        """Per-request observability context (ISSUE 6): echo or mint the
+        X-Request-Id every response carries, and note whether the client
+        opted into the X-Timing stage breakdown."""
+        self._req_id = ensure_request_id(self.headers.get("X-Request-Id"))
+        self._want_timing = self.headers.get("X-Timing") is not None
 
     def _send_response(
-        self, content, status: int = 200, degraded: bool = False
+        self,
+        content,
+        status: int = 200,
+        degraded: bool = False,
+        timing=None,
     ) -> None:
-        body = json.dumps(content).encode()
+        if isinstance(content, bytes):
+            # pre-rendered non-JSON body (the Prometheus exposition)
+            body = content
+            ctype = PROM_CONTENT_TYPE
+        else:
+            body = json.dumps(content).encode()
+            ctype = "application/json"
         self.send_response(status)
-        self.send_header("Content-type", "application/json")
+        self.send_header("Content-type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if self._req_id is not None:
+            self.send_header("X-Request-Id", self._req_id)
+        if timing is not None:
+            self.send_header("X-Timing", timing)
         if degraded:
             # the degraded-serving marker (serving/health.py): a header,
             # not a body key — the body stays byte-identical to the
@@ -402,31 +534,71 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         t0 = time.perf_counter()
+        self._begin_request()
         if self.path == "/solve":
             post_data = self._read_body("/solve", t0)
             if post_data is None:
                 return
-            status, payload, error, degraded = solve_route(
-                self.p2p_node, post_data,
-                deadline_ms=_parse_deadline_ms(
-                    self.headers.get("X-Deadline-Ms")
-                ),
+            trace = start_trace(self.p2p_node, "/solve", self._req_id)
+            try:
+                status, payload, error, degraded = solve_route(
+                    self.p2p_node, post_data,
+                    deadline_ms=_parse_deadline_ms(
+                        self.headers.get("X-Deadline-Ms")
+                    ),
+                )
+            except BaseException:
+                # same contract as the lean transport: a route-core crash
+                # must still close the span — the crashed request is
+                # exactly the span an incident dump needs
+                finish_trace(self.p2p_node, trace, 500)
+                raise
+            record = finish_trace(
+                self.p2p_node, trace, status, degraded=degraded
             )
             # record before replying: a client may poll /metrics the
             # instant its response arrives
             shed = status == 429
             self._record("/solve", t0, error=error and not shed, shed=shed)
-            self._send_response(payload, status, degraded=degraded)
+            self._send_response(
+                payload, status, degraded=degraded,
+                timing=timing_header_value(record)
+                if record is not None and self._want_timing
+                else None,
+            )
         elif self.path == "/solve_batch" and self.expose_batch:
             post_data = self._read_body(
                 "/solve_batch", t0, max_bytes=self.MAX_BATCH_BYTES
             )
             if post_data is None:
                 return
-            status, payload, error = solve_batch_route(
-                self.p2p_node, post_data
+            trace = start_trace(
+                self.p2p_node, "/solve_batch", self._req_id
             )
+            try:
+                status, payload, error = solve_batch_route(
+                    self.p2p_node, post_data
+                )
+            except BaseException:
+                finish_trace(self.p2p_node, trace, 500)
+                raise
+            record = finish_trace(self.p2p_node, trace, status)
             self._record("/solve_batch", t0, error=error)
+            self._send_response(
+                payload, status,
+                timing=timing_header_value(record)
+                if record is not None and self._want_timing
+                else None,
+            )
+        elif (
+            self.path == "/debug/flightrecord"
+            and getattr(self.p2p_node, "flight", None) is not None
+        ):
+            # operator dump trigger; body consumed for keep-alive framing
+            post_data = self._read_body("/debug/flightrecord", t0)
+            if post_data is None:
+                return
+            status, payload, _error = flightrecord_route(self.p2p_node)
             self._send_response(payload, status)
         else:
             # unknown POST path: the body was never read — under keep-alive
@@ -436,6 +608,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             self._send_response({"error": "Invalid endpoint"}, 404)
 
     def do_GET(self):
+        self._begin_request()
         if self.path == "/stats":
             self._send_response(
                 stats_payload(self.p2p_node, self.expose_serving)
@@ -444,6 +617,10 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             self._send_response(self.p2p_node.network_view())
         elif self.path == "/metrics" and self.expose_metrics:
             self._send_response(metrics_payload(self.p2p_node))
+        elif self.path in PROM_PATHS and self.expose_metrics:
+            # the Prometheus exposition of the same body (shared core —
+            # byte-identical on both transports)
+            self._send_response(metrics_prom_payload(self.p2p_node))
         elif self.path == "/healthz":
             self._send_response(healthz_payload(self.p2p_node))
         elif self.path == "/readyz":

@@ -29,6 +29,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..engine import SolverEngine
+from ..obs.trace import current_trace, valid_request_id
 from ..utils import HandicapLimiter
 from . import wire
 from .membership import Membership
@@ -154,6 +155,12 @@ class P2PNode:
         # duplicated deterministically. The fault tooling the reference
         # lacks (SURVEY.md §5); None costs nothing.
         self.fault_injector = fault_injector
+        # request-lifecycle tracing plane (obs/, ISSUE 6): the CLI wires a
+        # Tracer + FlightRecorder here (default on, --no-obs disables);
+        # None — library/bare nodes — costs nothing and serves exactly
+        # the pre-obs stack
+        self.tracer = None
+        self.flight = None
 
     # -- counters ----------------------------------------------------------
     # `solved` counts one per successful master solve (reference node.py:468
@@ -489,7 +496,9 @@ class P2PNode:
                     self.send_to(
                         msg["address"],
                         wire.solution_msg(
-                            msg["sudoku"], msg["row"], msg["col"], None, self.id
+                            msg["sudoku"], msg["row"], msg["col"], None,
+                            self.id,
+                            trace=valid_request_id(msg.get("trace")),
                         ),
                     )
                 except Exception:
@@ -505,18 +514,44 @@ class P2PNode:
         construction, None only if the dispatched board is unsatisfiable.
         """
         row, col, board, origin = msg["row"], msg["col"], msg["sudoku"], msg["address"]
+        # wire-propagated trace context (ISSUE 6): a traced master
+        # piggybacks its request's trace id on the dispatch (optional
+        # trailing key, validated at this ingress like every other wire
+        # field); the worker opens its OWN span under that id so the
+        # farmed cell's latency is attributable cross-node, and echoes
+        # the id on the solution
+        trace_id = valid_request_id(msg.get("trace"))
+        tracer = self.tracer
+        wtrace = (
+            tracer.start("farm-task", trace_id=trace_id)
+            if tracer is not None
+            else None
+        )
+        if wtrace is not None:
+            wtrace.farmed = True
         self._current_task = (row, col)
+        status = 200
         try:
             self.limiter.tick()  # the handicap contract, one tick per task
             # bucket path always: a farmed per-cell task must not occupy the
             # whole mesh the way a frontier-routed serving request does
             solution, _ = self.engine.solve_one(board, frontier=False)
             value = solution[row][col] if solution is not None else None
+            if value is None:
+                status = 400
             self.send_to(
-                origin, wire.solution_msg(board, row, col, value, self.id)
+                origin,
+                wire.solution_msg(
+                    board, row, col, value, self.id, trace=trace_id
+                ),
             )
+        except BaseException:
+            status = 500
+            raise
         finally:
             self._current_task = None
+            if tracer is not None:
+                tracer.finish(wtrace, status)
         self.broadcast_stats()  # same trigger as reference node.py:406
 
     # -- master side -------------------------------------------------------
@@ -612,6 +647,13 @@ class P2PNode:
     def _farm_solve(
         self, sudoku, peers: List[str], deadline_s=None
     ) -> Tuple[Optional[list], dict]:
+        # the requesting thread's span (obs/trace.py): its trace id rides
+        # every dispatched cell so peers' farmed-task spans correlate with
+        # this request's timeline, and the span is tagged as farmed
+        req_trace = current_trace()
+        trace_id = req_trace.trace_id if req_trace is not None else None
+        if req_trace is not None:
+            req_trace.farmed = True
         board = [list(r) for r in sudoku]
         with self._state_lock:
             self.task_queue.clear()
@@ -689,7 +731,8 @@ class P2PNode:
                         (
                             peer,
                             wire.solve_msg(
-                                [list(r) for r in board], i, j, self.id
+                                [list(r) for r in board], i, j, self.id,
+                                trace=trace_id,
                             ),
                         )
                     )

@@ -8,10 +8,16 @@ node's traffic is indistinguishable from the reference's:
   connected   {"type", "address"}                      reference node.py:199
   all_peers   {"type", "all_peers"}                    reference node.py:573
   disconnect  {"type", "address"[, "row", "col"]}      reference node.py:652-654
-  solve       {"type", "sudoku", "row", "col", "address"}   reference node.py:441
-  solution    {"type", "sudoku", "col", "row", "solution", "address"}
+  solve       {"type", "sudoku", "row", "col", "address"[, "trace"]}
+                                                      reference node.py:441
+  solution    {"type", "sudoku", "col", "row", "solution", "address"
+               [, "trace"]}
               (note: "col" BEFORE "row" — the reference really does emit this
-              order, node.py:402)
+              order, node.py:402; "trace" is this stack's optional
+              request-trace-id piggyback — absent unless the dispatching
+              master carried a traced request, keeping default traffic
+              byte-identical, same trailing-optional pattern as
+              disconnect's row/col and stats' health)
   stats       {"type", "origin", "solved", "stats": {"address", "validations"},
                "all_stats"[, "health"]}                reference node.py:583-592
               ("health" is this stack's optional supervisor-state
@@ -149,17 +155,56 @@ def disconnect_msg(self_address: str, task: Optional[Tuple[int, int]] = None) ->
     }
 
 
-def solve_msg(sudoku, row: int, col: int, self_address: str) -> Msg:
+def solve_msg(
+    sudoku,
+    row: int,
+    col: int,
+    self_address: str,
+    trace: Optional[str] = None,
+) -> Msg:
+    # ``trace`` piggybacks the originating request's trace id (obs/trace.py)
+    # on the task dispatch so a worker's farmed-cell span — and the
+    # solution it sends back — can be correlated with the master's request
+    # timeline across nodes. Optional-and-trailing like disconnect's
+    # row/col: absent when the master carried no traced request, so the
+    # default wire bytes stay identical to the reference's.
+    if trace is None:
+        return {
+            "type": "solve",
+            "sudoku": sudoku,
+            "row": row,
+            "col": col,
+            "address": self_address,
+        }
     return {
         "type": "solve",
         "sudoku": sudoku,
         "row": row,
         "col": col,
         "address": self_address,
+        "trace": trace,
     }
 
 
-def solution_msg(sudoku, row: int, col: int, solution, self_address: str) -> Msg:
+def solution_msg(
+    sudoku,
+    row: int,
+    col: int,
+    solution,
+    self_address: str,
+    trace: Optional[str] = None,
+) -> Msg:
+    # the worker echoes the dispatch's trace id back (same optionality),
+    # closing the cross-node correlation loop master-side
+    if trace is None:
+        return {
+            "type": "solution",
+            "sudoku": sudoku,
+            "col": col,
+            "row": row,
+            "solution": solution,
+            "address": self_address,
+        }
     return {
         "type": "solution",
         "sudoku": sudoku,
@@ -167,6 +212,7 @@ def solution_msg(sudoku, row: int, col: int, solution, self_address: str) -> Msg
         "row": row,
         "solution": solution,
         "address": self_address,
+        "trace": trace,
     }
 
 

@@ -1,0 +1,34 @@
+"""Request-lifecycle observability plane (ISSUE 6).
+
+Spans across admission → coalesce → device → verify (obs/trace.py),
+one latency-recording machinery for routes and stages (obs/histo.py),
+an always-on incident flight recorder (obs/flight.py), and Prometheus
+text exposition for the /metrics surface (obs/prom.py). Default-on in
+the serving CLI (net/cli.py ``--no-obs`` disables); a node built without
+a Tracer attached serves byte-identically to the PR 5 stack.
+"""
+
+from .flight import FlightRecorder
+from .histo import Histogram, LatencyWindow, RouteMetrics, StageMetrics
+from .trace import (
+    STAGES,
+    RequestTrace,
+    Tracer,
+    current_trace,
+    new_request_id,
+    valid_request_id,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "Histogram",
+    "LatencyWindow",
+    "RouteMetrics",
+    "StageMetrics",
+    "STAGES",
+    "RequestTrace",
+    "Tracer",
+    "current_trace",
+    "new_request_id",
+    "valid_request_id",
+]

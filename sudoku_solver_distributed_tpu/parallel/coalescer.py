@@ -55,6 +55,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs.trace import current_trace
 from ..serving.admission import DeadlineExceeded
 from ..utils.profiling import annotate
 
@@ -81,7 +82,7 @@ def _resolve(future: Future, result=None, exc=None) -> None:
 
 
 class _Request:
-    __slots__ = ("board", "future", "enqueued", "deadline")
+    __slots__ = ("board", "future", "enqueued", "deadline", "trace")
 
     def __init__(self, board: np.ndarray, deadline: Optional[float] = None):
         self.board = board
@@ -91,6 +92,12 @@ class _Request:
         # expired request is dropped at batch-formation time so the device
         # never solves a board nobody is waiting for
         self.deadline = deadline
+        # the submitting thread's request span (obs/trace.py), captured at
+        # enqueue time: the dispatcher/completer threads stamp queue /
+        # coalesce / device stage times onto it strictly BEFORE resolving
+        # the future, so the handler thread's finish-read is ordered by
+        # the future itself. None (no tracing plane) costs one slot.
+        self.trace = current_trace()
 
 
 class BatchCoalescer:
@@ -398,6 +405,9 @@ class BatchCoalescer:
                 # resolve outside the condition lock: future callbacks run
                 # inline in set_exception and must not re-enter the queue
                 for r in dropped:
+                    if r.trace is not None:
+                        # the expired request's whole life was queue wait
+                        r.trace.mark("queue", now - r.enqueued)
                     _resolve(
                         r.future,
                         exc=DeadlineExceeded(
@@ -427,10 +437,14 @@ class BatchCoalescer:
                 with self._stats_lock:
                     self.failed_batches += 1
                 for r in batch:
+                    if r.trace is not None:
+                        r.trace.mark("queue", now - r.enqueued)
                     _resolve(r.future, exc=e)
                 continue
+            t_dispatched = time.monotonic()
             with self._stats_lock:
                 self.batches += 1
+                batch_id = self.batches
                 self.boards += len(batch)
                 self.last_batch_fill = len(batch)
                 if len(batch) > self.max_batch_fill:
@@ -440,7 +454,20 @@ class BatchCoalescer:
                     self._wait_sum_s += w
                     if w > self._wait_max_s:
                         self._wait_max_s = w
-            self._inflight.put((handle, batch))  # blocks at pipeline depth
+            # span stamping (obs/trace.py), outside every lock: queue wait
+            # ended at batch formation (now), the coalesce stage is the
+            # stack/pad + async device enqueue that just ran; the padded
+            # width in the handle IS the bucket this batch dispatched at
+            bucket = int(handle[1].shape[0])
+            for r in batch:
+                tr = r.trace
+                if tr is not None:
+                    tr.mark("queue", now - r.enqueued)
+                    tr.mark("coalesce", t_dispatched - now)
+                    tr.bucket = bucket
+                    tr.batch_id = batch_id
+            # blocks at pipeline depth
+            self._inflight.put((handle, batch, t_dispatched))
         self._inflight.put(_SENTINEL)
 
     # -- completion side ---------------------------------------------------
@@ -453,7 +480,7 @@ class BatchCoalescer:
                 self._cond.notify_all()
             if item is _SENTINEL:
                 break
-            handle, batch = item
+            handle, batch, t_dispatched = item
             try:
                 # blocks on the device; the dispatcher is already encoding
                 # the next batch while we sit here
@@ -465,9 +492,25 @@ class BatchCoalescer:
                 logger.exception("coalescer completion failed")
                 with self._stats_lock:
                     self.failed_batches += 1
+                t_done = time.monotonic()
                 for r in batch:
+                    if r.trace is not None and not r.future.done():
+                        # the failed call's wall time is still device
+                        # time — but never stamp a future a starved
+                        # caller already cancelled (its handler may be
+                        # finishing the trace right now; Tracer.finish's
+                        # stage snapshot is the backstop for the
+                        # unavoidable check-then-mark window)
+                        r.trace.mark("device", t_done - t_dispatched)
                     _resolve(r.future, exc=e)
                 continue
+            # device stage: async enqueue -> fetched host rows; stamped
+            # before the futures resolve (the finish-read ordering edge);
+            # cancelled futures skipped — see the failure path above
+            t_done = time.monotonic()
+            for r in batch:
+                if r.trace is not None and not r.future.done():
+                    r.trace.mark("device", t_done - t_dispatched)
             for r, res in zip(batch, results):
                 # a caller may cancel() its future while the batch is in
                 # flight (starved supervised awaits do, and futures are

@@ -67,6 +67,7 @@ from typing import Optional
 import numpy as np
 
 from ..models.oracle import oracle_is_valid_solution, oracle_solve
+from ..obs.trace import current_trace
 
 logger = logging.getLogger(__name__)
 
@@ -362,6 +363,8 @@ class EngineSupervisor:
         transport worker — the same queue-wait-only contract as the
         coalescer's batch-formation drop."""
         arr = np.asarray(board, np.int32)
+        tr = current_trace()  # the request's span, when tracing is on
+        t0 = time.monotonic()
         with self._fallback_sem:
             if deadline_s is not None and time.monotonic() > deadline_s:
                 from .admission import DeadlineExceeded
@@ -370,6 +373,12 @@ class EngineSupervisor:
                     "deadline expired waiting for the fallback slot"
                 )
             solution = oracle_solve(arr.tolist())
+        if tr is not None:
+            # fallback stage = semaphore wait + oracle solve; the flags
+            # make degraded-mode serving first-class in the timeline
+            tr.mark("fallback", time.monotonic() - t0)
+            tr.fallback = True
+            tr.degraded = True
         with self._lock:
             self.fallback_served += 1
             state = self.state
@@ -410,6 +419,13 @@ class EngineSupervisor:
             "device claimed UNSAT for a solvable board — poisoned "
             "program? serving the oracle's solution"
         )
+        tr = current_trace()
+        if tr is not None:
+            # the cross-check's oracle answer IS fallback serving (the
+            # wall time rides the verify stage the engine stamps around
+            # this call)
+            tr.fallback = True
+            tr.degraded = True
         with self._lock:
             self.fallback_served += 1
             state = self.state

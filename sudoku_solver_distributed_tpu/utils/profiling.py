@@ -5,10 +5,11 @@ HTTP solve (reference node.py:674, 681-683) and two gossip counters
 (SURVEY.md §5). This module adds the TPU-framework equivalents without
 touching the byte-identical HTTP/UDP surfaces:
 
-  * ``RequestMetrics`` — thread-safe per-route latency recorder (ring buffer)
-    with count / p50 / p95 / p99 / max summaries, fed by the HTTP layer and
-    surfaced on the opt-in ``/metrics`` endpoint (gated behind a CLI flag;
-    with the flag off, unknown paths 404 exactly like the reference).
+  * ``RequestMetrics`` — thread-safe per-route latency recorder with
+    count / p50 / p95 / p99 / max summaries, fed by the HTTP layer and
+    surfaced on the ``/metrics`` endpoint. Since ISSUE 6 this is an alias
+    of ``obs.histo.RouteMetrics`` — the request-lifecycle tracing plane's
+    recording machinery — kept importable here for compatibility.
   * ``device_trace`` — context manager around ``jax.profiler.trace``: dumps
     an XLA/TPU trace viewable in TensorBoard/Perfetto for any code region
     (the serving path wires it to a ``--profile-dir`` CLI flag).
@@ -29,72 +30,15 @@ so a ``--profile-dir`` trace separates host scheduling from device time:
 from __future__ import annotations
 
 import contextlib
-import threading
-from collections import deque
-from typing import Dict, Iterator, Optional
+from typing import Iterator, Optional
 
-
-class RequestMetrics:
-    """Per-route latency ring buffer with percentile summaries."""
-
-    def __init__(self, window: int = 2048):
-        self._lock = threading.Lock()
-        self._window = window
-        self._lat: Dict[str, deque] = {}
-        self._count: Dict[str, int] = {}
-        self._errors: Dict[str, int] = {}
-        self._shed: Dict[str, int] = {}
-
-    def record(
-        self,
-        route: str,
-        seconds: float,
-        error: bool = False,
-        shed: bool = False,
-    ) -> None:
-        """``shed`` marks an admission 429 (serving/admission.py): counted
-        separately from ``errors`` — a shed is the overload control plane
-        WORKING, and lumping it with malformed-body 400s would make the
-        error rate useless as an alarm exactly when traffic is heaviest.
-        Shed replies still land in the latency window (they are real
-        responses the client waited for — microseconds, which is the
-        point)."""
-        with self._lock:
-            if route not in self._lat:
-                self._lat[route] = deque(maxlen=self._window)
-                self._count[route] = 0
-                self._errors[route] = 0
-                self._shed[route] = 0
-            self._lat[route].append(seconds)
-            self._count[route] += 1
-            if error:
-                self._errors[route] += 1
-            if shed:
-                self._shed[route] += 1
-
-    @staticmethod
-    def _pct(sorted_vals, q: float) -> float:
-        if not sorted_vals:
-            return 0.0
-        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-        return sorted_vals[idx]
-
-    def summary(self) -> Dict[str, Dict[str, float]]:
-        """{route: {count, errors, shed, p50_ms, p95_ms, p99_ms, max_ms}}."""
-        with self._lock:
-            out: Dict[str, Dict[str, float]] = {}
-            for route, window in self._lat.items():
-                vals = sorted(window)
-                out[route] = {
-                    "count": self._count[route],
-                    "errors": self._errors[route],
-                    "shed": self._shed[route],
-                    "p50_ms": round(self._pct(vals, 0.50) * 1e3, 3),
-                    "p95_ms": round(self._pct(vals, 0.95) * 1e3, 3),
-                    "p99_ms": round(self._pct(vals, 0.99) * 1e3, 3),
-                    "max_ms": round((max(vals) if vals else 0.0) * 1e3, 3),
-                }
-            return out
+# RequestMetrics is now an alias of the observability plane's per-route
+# recorder (ISSUE 6 satellite): one recording machinery for route latency
+# and stage latency instead of two parallel ring-buffer implementations,
+# with the percentile window and its counters behind one lock for BOTH
+# mutation and read under the fastserve worker pool. The import path and
+# the record()/summary() surface (and summary JSON shape) are unchanged.
+from ..obs.histo import RouteMetrics as RequestMetrics  # noqa: F401
 
 
 @contextlib.contextmanager

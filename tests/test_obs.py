@@ -1,0 +1,499 @@
+"""Request-lifecycle tracing plane (ISSUE 6, obs/).
+
+Deterministic coverage of the observability tentpole: span completeness
+on every serving route (solve, solve_batch, farm-task, degraded
+fallback), the X-Request-Id / X-Timing response headers on both
+transports, wire trace-id roundtrip + absent-key back-compat, the
+flight recorder's incident dump on an injected breaker trip
+(utils/faults.EngineFaultInjector — no sleep-and-hope), Prometheus
+exposition that parses line-by-line AND agrees with the /metrics JSON
+block, and transport parity (the SAME node served by both transports
+answers byte-identical exposition bodies).
+"""
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.net import wire
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.obs import (
+    FlightRecorder,
+    Tracer,
+    current_trace,
+    valid_request_id,
+)
+from sudoku_solver_distributed_tpu.serving.health import (
+    DEGRADED,
+    HEALTHY,
+    EngineSupervisor,
+)
+from sudoku_solver_distributed_tpu.utils import EngineFaultInjector
+from sudoku_solver_distributed_tpu.utils.profiling import RequestMetrics
+
+BOARD = [[0] * 9 for _ in range(9)]
+BOARD[0][0] = 5
+
+
+def free_udp_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for(pred, timeout=8.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1, 4), coalesce=True)
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def served(engine):
+    """One traced node behind BOTH transports (the lean default and the
+    stock handler), sharing the same node object — the transport-parity
+    harness."""
+    flight = FlightRecorder(dump_dir=None)
+    tracer = Tracer(recorder=flight)
+    node = P2PNode(
+        "127.0.0.1", free_udp_port(), engine=engine, metrics=tracer.routes
+    )
+    node.tracer = tracer
+    node.flight = flight
+    fast = make_http_server(
+        node, "127.0.0.1", 0, expose_metrics=True, expose_batch=True
+    )
+    legacy = make_http_server(
+        node, "127.0.0.1", 0, expose_metrics=True, expose_batch=True,
+        legacy_transport=True,
+    )
+    threads = [
+        threading.Thread(target=s.serve_forever, daemon=True)
+        for s in (fast, legacy)
+    ]
+    for t in threads:
+        t.start()
+    yield {
+        "node": node,
+        "tracer": tracer,
+        "flight": flight,
+        "fast": fast.server_address[1],
+        "legacy": legacy.server_address[1],
+    }
+    fast.shutdown()
+    legacy.shutdown()
+
+
+def post(port, path, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else b"",
+        headers=headers or {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        # r.headers is an email.Message: case-insensitive lookup, which
+        # is the HTTP contract (the two transports differ in case)
+        return r.status, r.headers, json.loads(r.read())
+
+
+def get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, r.headers, r.read()
+
+
+# -- spans + headers ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("transport", ["fast", "legacy"])
+def test_solve_span_complete_and_headers(served, transport):
+    """A traced /solve answers X-Request-Id (echoed) + X-Timing (opt-in)
+    on BOTH transports, and the finished span carries the full stage
+    breakdown with the coalescer's batch attribution."""
+    port = served[transport]
+    status, headers, body = post(
+        port, "/solve", {"sudoku": BOARD},
+        headers={"X-Timing": "1", "X-Request-Id": "corr-1"},
+    )
+    assert status == 200
+    assert headers["X-Request-Id"] == "corr-1"
+    timing = json.loads(headers["X-Timing"])
+    for key in (
+        "total_ms", "queue_ms", "coalesce_ms", "device_ms", "verify_ms",
+        "fallback_ms", "bucket", "batch_id", "degraded", "fallback",
+        "farmed",
+    ):
+        assert key in timing, f"X-Timing missing {key}"
+    # the coalesced path really was timed: device time is real wall time,
+    # the batch tags point at a real dispatched batch
+    assert timing["total_ms"] > 0
+    assert timing["device_ms"] > 0
+    assert timing["bucket"] in (1, 4)
+    assert timing["batch_id"] >= 1
+    assert timing["degraded"] is False and timing["fallback"] is False
+
+
+def test_solve_without_timing_header_gets_no_breakdown(served):
+    status, headers, _ = post(served["fast"], "/solve", {"sudoku": BOARD})
+    assert status == 200
+    assert "X-Timing" not in headers
+    # but the request id is always there (generated, well-formed)
+    assert valid_request_id(headers["X-Request-Id"])
+
+
+def test_solve_batch_span(served):
+    status, headers, body = post(
+        served["fast"], "/solve_batch", {"sudokus": [BOARD, BOARD]},
+        headers={"X-Timing": "1"},
+    )
+    assert status == 200 and body["solved"] == 2
+    timing = json.loads(headers["X-Timing"])
+    assert timing["device_ms"] > 0
+    # spans land in the ring with their route
+    routes = [
+        s["route"]
+        for s in served["flight"].dump(reason="test")["payload"]["spans"]
+    ]
+    assert "/solve_batch" in routes and "/solve" in routes
+
+
+def test_request_id_on_every_route_and_404(served):
+    for path in ("/stats", "/network", "/healthz", "/nope"):
+        try:
+            _status, headers, _ = get(served["fast"], path)
+        except urllib.error.HTTPError as e:  # the 404
+            headers = e.headers
+        assert valid_request_id(headers["X-Request-Id"]), path
+
+
+# -- degraded fallback + flight recorder -------------------------------------
+
+
+def test_breaker_trip_dumps_flightrecord_with_poisoned_span(
+    engine, tmp_path
+):
+    """The acceptance shape: a poisoned program serves a silently-wrong
+    answer, host verification catches it, the breaker trips, and the
+    flight recorder's incident dump contains that request's span — with
+    per-stage timings and the fallback flag."""
+    flight = FlightRecorder(dump_dir=str(tmp_path), incident_delay_s=0.1)
+    tracer = Tracer(recorder=flight)
+    inj = EngineFaultInjector()
+    engine.fault_injector = inj
+    sup = EngineSupervisor(engine, probe_interval_s=600.0)
+    flight.attach_supervisor(sup)
+    try:
+        assert sup.state == HEALTHY
+        inj.poison_bucket(1)
+        trace = tracer.start("/solve")
+        solution, info = engine.solve_one_supervised(BOARD)
+        tracer.finish(trace, 200, degraded=bool(info.get("degraded")))
+        assert solution is not None  # fallback answered correctly
+        assert sup.state == DEGRADED
+        assert wait_for(lambda: flight.stats()["dumps"] >= 1, timeout=5.0)
+        path = flight.stats()["last_dump_path"]
+        assert path and path.startswith(str(tmp_path))
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["reason"] == "breaker-degraded"
+        # the supervisor transition is in the event timeline
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "supervisor-transition" in kinds
+        # ...and the poisoned request's span is in the ring, stage-timed
+        poisoned = [s for s in payload["spans"] if s["fallback"]]
+        assert poisoned, payload["spans"]
+        span = poisoned[-1]
+        assert span["degraded"] is True
+        assert span["device_ms"] > 0       # the poisoned device call ran
+        assert span["verify_ms"] >= 0.0    # verification caught it
+        assert span["fallback_ms"] > 0     # the oracle answered
+        assert span["bucket"] == 1 and span["batch_id"] >= 1
+    finally:
+        sup.close()
+        engine.supervisor = None
+        engine.fault_injector = None
+        inj.clear()
+
+
+def test_shed_storm_triggers_dump(tmp_path):
+    flight = FlightRecorder(
+        dump_dir=str(tmp_path),
+        shed_storm_threshold=8,
+        shed_storm_window_s=5.0,
+        incident_delay_s=0.05,
+    )
+    tracer = Tracer(recorder=flight)
+    for _ in range(8):
+        t = tracer.start("/solve")
+        tracer.finish(t, 429)
+    assert wait_for(lambda: flight.stats()["dumps"] >= 1, timeout=5.0)
+    assert flight.stats()["last_dump_reason"] == "shed-storm"
+
+
+def test_flightrecord_http_trigger(served):
+    status, _headers, body = post(served["fast"], "/debug/flightrecord", None)
+    assert status == 200 and body["dumped"] is True
+    # dir-less recorder serves the record inline — it still parses and
+    # carries span rows
+    assert body["path"] is None and "record" in body
+    assert isinstance(body["record"]["spans"], list)
+
+
+def test_flightrecord_404_without_recorder(engine):
+    node = P2PNode(
+        "127.0.0.1", free_udp_port(), engine=engine,
+        metrics=RequestMetrics(),
+    )
+    httpd = make_http_server(node, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(httpd.server_address[1], "/debug/flightrecord", None)
+        assert e.value.code == 404
+        assert json.loads(e.value.read()) == {"error": "Invalid endpoint"}
+    finally:
+        httpd.shutdown()
+
+
+# -- wire propagation --------------------------------------------------------
+
+
+def test_wire_trace_key_optional_and_ordered():
+    """Back-compat: without a trace the messages are byte-identical to
+    the reference's field order; with one, the key trails."""
+    base = wire.solve_msg(BOARD, 0, 1, "127.0.0.1:7000")
+    assert list(base) == ["type", "sudoku", "row", "col", "address"]
+    traced = wire.solve_msg(BOARD, 0, 1, "127.0.0.1:7000", trace="abc123")
+    assert list(traced) == [
+        "type", "sudoku", "row", "col", "address", "trace",
+    ]
+    sol = wire.solution_msg(BOARD, 0, 1, 5, "127.0.0.1:7000")
+    assert list(sol) == [
+        "type", "sudoku", "col", "row", "solution", "address",
+    ]
+    sol_t = wire.solution_msg(
+        BOARD, 0, 1, 5, "127.0.0.1:7000", trace="abc123"
+    )
+    assert sol_t["trace"] == "abc123"
+    # roundtrip through the codec
+    assert wire.decode_msg(wire.encode_msg(traced))["trace"] == "abc123"
+
+
+def test_worker_farm_task_span_and_trace_echo(engine):
+    """A dispatched cell carrying a trace id: the worker opens its own
+    farm-task span under that id (cross-node attribution) and echoes the
+    id on the solution datagram; a dispatch WITHOUT the key (reference
+    traffic) answers without it."""
+    flight = FlightRecorder(dump_dir=None)
+    tracer = Tracer(recorder=flight)
+    node = P2PNode(
+        "127.0.0.1", free_udp_port(), engine=engine, metrics=tracer.routes
+    )
+    node.tracer = tracer
+    node.flight = flight
+    # a listening "master" socket the worker replies to
+    master = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    master.bind(("127.0.0.1", 0))
+    master.settimeout(10.0)
+    origin = f"127.0.0.1:{master.getsockname()[1]}"
+    try:
+        board = [row[:] for row in BOARD]
+        board[0][0] = 0  # all-holes: every cell farmable
+        node._on_solve_task(
+            wire.solve_msg(board, 0, 0, origin, trace="trace-xyz")
+        )
+        payload, _ = master.recvfrom(wire.RECV_BUFFER)
+        reply = wire.decode_msg(payload)
+        assert reply["type"] == "solution" and reply["trace"] == "trace-xyz"
+        spans = flight.dump(reason="test")["payload"]["spans"]
+        farm = [s for s in spans if s["route"] == "farm-task"]
+        assert farm and farm[-1]["trace_id"] == "trace-xyz"
+        assert farm[-1]["farmed"] is True
+        # absent-key back-compat: reference-shaped dispatch, no trace out
+        node._on_solve_task(wire.solve_msg(board, 0, 1, origin))
+        payload, _ = master.recvfrom(wire.RECV_BUFFER)
+        assert "trace" not in wire.decode_msg(payload)
+    finally:
+        master.close()
+        node.shutdown_flag = True
+
+
+def test_master_farm_span_marks_farmed(engine):
+    """The farm path's master span: peer_sudoku_solve_info with peers
+    tags the request span farmed=True (the wire id it dispatched is the
+    span's own trace id). The peer here is a mute socket — the farm falls
+    back to the authoritative engine once the worker 'departs', which is
+    fine: the span tagging happens at farm entry."""
+    tracer = Tracer()
+    node = P2PNode(
+        "127.0.0.1",
+        free_udp_port(),
+        engine=engine,
+        metrics=tracer.routes,
+        failure_timeout=0.0,
+    )
+    node.tracer = tracer
+    trace = tracer.start("/solve", trace_id="farmspan")
+    try:
+        # no peers: engine path — farmed stays False
+        node.peer_sudoku_solve_info(BOARD)
+        assert trace.farmed is False
+    finally:
+        rec = tracer.finish(trace, 200)
+    assert rec["farmed"] is False and rec["device_ms"] > 0
+    node.shutdown_flag = True
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:TYPE|HELP) .*|"
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? "
+    r"[-+]?(?:[0-9.]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))$"
+)
+
+
+def _prom_values(text):
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        out[name] = float(value)
+    return out
+
+
+def test_prom_exposition_parses_and_agrees_with_json(served):
+    # traffic first so the stage histograms are non-empty
+    post(served["fast"], "/solve", {"sudoku": BOARD})
+    _s, _h, raw_json = get(served["fast"], "/metrics")
+    body = json.loads(raw_json)
+    _s, headers, raw_prom = get(served["fast"], "/metrics.prom")
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+    text = raw_prom.decode()
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line), f"unparseable prom line: {line!r}"
+    values = _prom_values(text)
+    # the JSON block and the exposition agree (same underlying dict; the
+    # node is quiescent between the two scrapes — GET /metrics itself is
+    # not a traced/recorded route)
+    assert values['sudoku_route_count{route="/solve"}'] == (
+        body["/solve"]["count"]
+    )
+    assert values["sudoku_obs_finished"] == body["obs"]["finished"]
+    dev = body["obs"]["stages"]["device"]
+    assert values['sudoku_stage_latency_ms_count{stage="device"}'] == (
+        dev["count"]
+    )
+    assert values['sudoku_stage_latency_ms_sum{stage="device"}'] == (
+        pytest.approx(dev["sum_ms"], abs=0.01)
+    )
+    # histogram buckets are cumulative and end at +Inf == count
+    assert values['sudoku_stage_latency_ms_bucket{stage="device",le="+Inf"}'] == (
+        dev["count"]
+    )
+
+
+def test_prom_transport_parity_and_query_spelling(served):
+    """Byte-identical exposition on both transports and both spellings
+    (the node is shared and quiescent, so four scrapes see one state)."""
+    bodies = [
+        get(served[t], p)[2]
+        for t in ("fast", "legacy")
+        for p in ("/metrics.prom", "/metrics?format=prom")
+    ]
+    assert bodies[0] == bodies[1] == bodies[2] == bodies[3]
+
+
+def test_prom_404_without_metrics_flag(served, engine):
+    httpd = make_http_server(
+        P2PNode(
+            "127.0.0.1", free_udp_port(), engine=engine,
+            metrics=RequestMetrics(),
+        ),
+        "127.0.0.1", 0,
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(httpd.server_address[1], "/metrics.prom")
+        assert e.value.code == 404
+    finally:
+        httpd.shutdown()
+
+
+# -- folded RequestMetrics + device-trace satellite --------------------------
+
+
+def test_request_metrics_alias_shape_unchanged():
+    """utils/profiling.RequestMetrics is the obs recorder now; the import
+    path and the summary JSON shape both survive the fold."""
+    from sudoku_solver_distributed_tpu.obs.histo import RouteMetrics
+
+    assert RequestMetrics is RouteMetrics
+    m = RequestMetrics(window=8)
+    m.record("/solve", 0.004)
+    m.record("/solve", 0.001, error=True)
+    m.record("/solve", 0.0001, shed=True)
+    s = m.summary()["/solve"]
+    assert set(s) == {
+        "count", "errors", "shed", "p50_ms", "p95_ms", "p99_ms", "max_ms",
+    }
+    assert s["count"] == 3 and s["errors"] == 1 and s["shed"] == 1
+
+
+def test_device_trace_capture_counters(tmp_path):
+    """--device-trace-dir plumbing: one warmup artifact + the first N
+    supervised calls, observable from warm_info()."""
+    eng = SolverEngine(buckets=(1,), coalesce=False)
+    eng.arm_device_trace(str(tmp_path), calls=1)
+    eng.warmup()
+    info = eng.warm_info()["device_trace"]
+    assert info["warmup_traced"] is True
+    assert info["calls_remaining"] == 1
+    eng.solve_one(BOARD)
+    info = eng.warm_info()["device_trace"]
+    assert info["captured_calls"] == 1 and info["calls_remaining"] == 0
+    # budget spent: later calls trace nothing further
+    eng.solve_one(BOARD)
+    assert eng.warm_info()["device_trace"]["captured_calls"] == 1
+    # the profiler actually wrote an artifact
+    assert any(tmp_path.iterdir())
+    eng.close()
+
+
+def test_tracer_thread_local_isolation():
+    """A span opened on one thread is invisible to another (the whole
+    correctness basis of the thread-local hand-off)."""
+    tracer = Tracer()
+    t = tracer.start("/solve")
+    seen = []
+    other = threading.Thread(target=lambda: seen.append(current_trace()))
+    other.start()
+    other.join()
+    assert seen == [None]
+    assert current_trace() is t
+    tracer.finish(t, 200)
+    assert current_trace() is None
