@@ -1,0 +1,64 @@
+"""Shared bootstrap for the scripts in benchmarks/ (ISSUE 7 satellite).
+
+Every experiment / session script in this directory used to open with its
+own copy of the same three stanzas; they live here once:
+
+  * repo-root ``sys.path`` insertion — these scripts run as plain files
+    (``python benchmarks/exp_*.py``) so the package is not importable
+    until the repo root is on the path. Deliberately NOT via PYTHONPATH:
+    exporting it breaks this environment's TPU plugin discovery
+    (exp_pallas.py, round 2).
+  * corpus/artifact path helpers anchored at the repo root, so scripts
+    work from any CWD.
+  * the TPU persistent-compile-cache env defaults shared with bench.py
+    (``setup_compile_cache_env``): a serving-config compile that succeeds
+    once in ANY claim window is reused by every later attempt — on the
+    tunneled chip, compiles are the scarce resource.
+
+Usage (first import in every benchmarks/ script, before jax)::
+
+    import _bootstrap  # noqa: F401  (repo root now importable)
+    from _bootstrap import corpus_path, REPO
+
+Import order note: ``import _bootstrap`` works because Python puts the
+script's own directory (benchmarks/) on sys.path entry 0.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+BENCHMARKS = os.path.join(REPO, "benchmarks")
+
+
+def repo_path(*parts: str) -> str:
+    """Absolute path under the repo root."""
+    return os.path.join(REPO, *parts)
+
+
+def corpus_path(name: str) -> str:
+    """Absolute path of a cached corpus / artifact in benchmarks/."""
+    return os.path.join(BENCHMARKS, name)
+
+
+def load_corpus(name: str, key: str = "boards"):
+    """Load a committed .npz corpus by file name."""
+    import numpy as np
+
+    return np.load(corpus_path(name))[key]
+
+
+def setup_compile_cache_env() -> str:
+    """Point jax's persistent compile cache at the shared measurement-
+    session cache (bench.py owns the ONE path definition) unless the
+    caller already configured one. Returns the directory in effect.
+    Must run before jax initializes."""
+    from bench import COMPILE_CACHE_DIR  # sys.path set above
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]

@@ -9,6 +9,7 @@ Variants (monkeypatched into the solver step):
 
 import time
 
+import _bootstrap  # noqa: F401 — repo root onto sys.path
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -21,7 +22,7 @@ from sudoku_solver_distributed_tpu.ops.encode import (
 )
 from sudoku_solver_distributed_tpu.ops.propagate import Analysis
 
-corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+corpus = np.load(_bootstrap.corpus_path("corpus_9x9_hard_4096.npz"))["boards"]
 MULT = 4
 big = jnp.asarray(np.tile(corpus, (MULT, 1, 1)))
 B_TOTAL = big.shape[0]

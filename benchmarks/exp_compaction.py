@@ -8,6 +8,7 @@ run manually: python benchmarks/exp_compaction.py
 import itertools
 import time
 
+import _bootstrap  # noqa: F401 — repo root onto sys.path
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,8 +16,11 @@ import numpy as np
 from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
 from sudoku_solver_distributed_tpu.ops import solver as S
 
-corpus = np.load("benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+corpus = np.load(_bootstrap.corpus_path("corpus_9x9_hard_4096.npz"))["boards"]
 dev = jnp.asarray(corpus)
+
+
+EVERY = int(__import__("os").environ.get("EXP_COMPACT_EVERY", "1"))
 
 
 def schedule(B, div, floor):
@@ -29,7 +33,10 @@ def schedule(B, div, floor):
 def run(caps, max_depth, reps=3):
     def fn(g):
         state = S.init_state(g, SPEC_9, max_depth)
-        state = S._run_compacted(state, caps, SPEC_9, 4096)
+        # PR 7 signature: stats threading + descent-check period K
+        state, _ = S._run_compacted(
+            state, S._zero_stats(), caps, SPEC_9, 4096, every=EVERY
+        )
         state = S.finalize_status(state, SPEC_9)
         return state.grid, state.status, state.iters
 

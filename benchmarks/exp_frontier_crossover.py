@@ -28,8 +28,7 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 
 STATES = int(os.environ.get("XO_STATES", "64"))
 CONTROL = int(os.environ.get("XO_CONTROL", "32"))

@@ -27,8 +27,7 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 
 REPS = int(os.environ.get("HO_REPS", "3"))
 N_DEEP = int(os.environ.get("HO_DEEP", "48"))

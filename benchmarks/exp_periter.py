@@ -2,6 +2,7 @@
 
 import time
 
+import _bootstrap  # noqa: F401 — repo root onto sys.path
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -9,7 +10,7 @@ import numpy as np
 from sudoku_solver_distributed_tpu.ops import SPEC_9
 from sudoku_solver_distributed_tpu.ops import solver as S
 
-corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+corpus = np.load(_bootstrap.corpus_path("corpus_9x9_hard_4096.npz"))["boards"]
 
 # fixed-iteration run: cost per iteration at batch B
 for B in [64, 256, 1024, 4096]:

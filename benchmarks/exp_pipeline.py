@@ -8,13 +8,14 @@
 
 import time
 
+import _bootstrap  # noqa: F401 — repo root onto sys.path
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
 
-corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+corpus = np.load(_bootstrap.corpus_path("corpus_9x9_hard_4096.npz"))["boards"]
 B = corpus.shape[0]
 dev = jnp.asarray(corpus)
 

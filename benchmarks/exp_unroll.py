@@ -8,6 +8,7 @@ boards are no-ops, so semantics are unchanged.
 import sys
 import time
 
+import _bootstrap  # noqa: F401 — repo root onto sys.path
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,7 +16,7 @@ import numpy as np
 from sudoku_solver_distributed_tpu.ops import SPEC_9
 from sudoku_solver_distributed_tpu.ops import solver as S
 
-corpus = np.load("/root/repo/benchmarks/corpus_9x9_hard_4096.npz")["boards"]
+corpus = np.load(_bootstrap.corpus_path("corpus_9x9_hard_4096.npz"))["boards"]
 dev = jnp.asarray(corpus)
 
 

@@ -23,8 +23,7 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 
 CANDIDATES = int(os.environ.get("ADV_CANDIDATES", "4096"))
 KEEP = int(os.environ.get("ADV_KEEP", "128"))

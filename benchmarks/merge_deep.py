@@ -21,8 +21,7 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 
 KEEP = int(os.environ.get("MERGE_KEEP", "256"))
 

@@ -22,8 +22,7 @@ import random
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 
 SECONDS = float(os.environ.get("MINE_SECONDS", "1800"))
 RESTART_S = float(os.environ.get("MINE_RESTART_S", "300"))

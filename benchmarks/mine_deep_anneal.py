@@ -33,8 +33,7 @@ import random
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 
 SECONDS = float(os.environ.get("MINE_SECONDS", "1800"))
 SIZE = int(os.environ.get("MINE_SIZE", "9"))

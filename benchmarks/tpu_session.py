@@ -42,8 +42,7 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 OUT = os.path.join(REPO, "benchmarks", "tpu_session_r4.jsonl")
 R3_OUT = os.path.join(REPO, "benchmarks", "tpu_session_r3.jsonl")
 STOP_FLAG = os.path.join(REPO, "benchmarks", "tpu_stop")

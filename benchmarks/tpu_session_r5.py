@@ -48,8 +48,7 @@ import sys
 import threading
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from _bootstrap import REPO  # noqa: E402 — repo root onto sys.path
 OUT = os.path.join(REPO, "benchmarks", "tpu_session_r5.jsonl")
 STOP_FLAG = os.path.join(REPO, "benchmarks", "tpu_stop")
 # Default: ~9h after round-5 start (round began 2026-07-31 03:45 UTC,
@@ -69,11 +68,9 @@ TARGET_PER_CHIP = 100_000.0  # BASELINE.md 9x9 north star
 # reused by every later attempt/phase and by bench.py (which owns the ONE
 # path definition), so a short claim window is spent measuring, not
 # compiling.
-from bench import COMPILE_CACHE_DIR  # noqa: E402 — sys.path set above
+from _bootstrap import setup_compile_cache_env  # noqa: E402
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+setup_compile_cache_env()
 
 
 def emit(record, path=OUT):

@@ -132,6 +132,15 @@ class SolverEngine:
       aot_artifacts: with ``compile_cache_dir``, also use the explicit
         AOT store (default True). False keeps only the implicit XLA
         cache — the coldstart bench A/Bs the two layers separately.
+      solver_config: hot-loop escape hatch (PR 7): a preset name
+        ("default" | "legacy") or a dict of raw ``solve_batch`` overrides
+        (packed / compact_div / compact_floor / compact_every /
+        legacy_loop — ops/config.resolve_solver_overrides). "legacy"
+        restores the pre-PR7 loop for A/B (``bench.py --mode hotloop``)
+        on every solve path — bucket programs, the quick-state probe,
+        the frontier race's step loop, and the sharded solver; only the
+        one-off seeding/finalize helper sweeps keep the default analysis
+        (bit-identical outputs either way). xla backend only.
 
     All unspecified solver knobs resolve from ops.SERVING_CONFIG, the single
     definition site shared with bench.py and __graft_entry__ — the benched
@@ -165,6 +174,7 @@ class SolverEngine:
         coalesce_adaptive: bool = False,
         compile_cache_dir: Optional[str] = None,
         aot_artifacts: bool = True,
+        solver_config=None,
     ):
         if backend not in ("xla", "pallas"):
             raise ValueError(f"unknown engine backend {backend!r}")
@@ -282,6 +292,18 @@ class SolverEngine:
                 else False
             )
         self.naked_pairs = naked_pairs
+        # Hot-loop overrides (the --solver-config escape hatch): resolved
+        # once here, applied to every bucket program's solve_batch call and
+        # surfaced at warm_info()["solver_loop"] so a serving node's active
+        # compaction schedule is observable from /metrics.
+        from .ops.config import resolve_solver_overrides
+
+        self.solver_overrides = resolve_solver_overrides(solver_config)
+        if self.solver_overrides and backend == "pallas":
+            raise ValueError(
+                "solver_config overrides apply to the xla hot loop only — "
+                "the pallas kernel has its own block-granular schedule"
+            )
         if max_iters is None:
             max_iters = cfg.get("max_iters", 4096)
         # Iteration budget per device call, and the RUNNING safety net: a
@@ -434,6 +456,7 @@ class SolverEngine:
                     locked_candidates=self.locked_candidates,
                     waves=waves_eff,
                     naked_pairs=self.naked_pairs,
+                    **self.solver_overrides,
                 )
             # Pack every result field into ONE int32 array: the serving path
             # pays exactly one device→host transfer per request. (Unpacked,
@@ -504,6 +527,9 @@ class SolverEngine:
                     s.iters < frontier_escalate_iters
                 )
 
+            # the probe traces with the same loop flavor as the bucket
+            # programs: --solver-config=legacy means legacy end to end
+            _packed, _legacy = self._loop_flavor()
             st = jax.lax.while_loop(
                 cond,
                 lambda s: _solver.step(
@@ -512,6 +538,8 @@ class SolverEngine:
                     self.locked_candidates,
                     1,  # waves_eff for a single board (see _run)
                     naked_pairs=self.naked_pairs,
+                    packed=_packed,
+                    legacy_merges=_legacy,
                 ),
                 st,
             )
@@ -1096,13 +1124,23 @@ class SolverEngine:
         AOT artifact key's config component. ``max_iters`` and the probe
         budget are absent on purpose: they are traced ARGUMENTS of the
         shared program, not trace constants."""
-        return {
+        cfg = {
             "backend": self.backend,
             "max_depth": self.max_depth,
             "locked_candidates": self.locked_candidates,
             "waves": self.waves,
             "naked_pairs": self.naked_pairs,
         }
+        if self.backend == "xla":
+            # the RESOLVED hot-loop shape (ladder, period, packing, legacy
+            # escape hatch) is part of the traced graph: a legacy-loop
+            # engine must never load a default-loop artifact (functionally
+            # identical, but an A/B would silently measure the wrong
+            # program), and a changed default schedule must re-bake
+            cfg["solver_loop"] = dict(
+                sorted(self.solver_loop_info().items())
+            )
+        return cfg
 
     def _aot_load_or_compile(self, b: int):
         """Returns (executable | None, source). Load path: artifact with
@@ -1272,12 +1310,48 @@ class SolverEngine:
             self.locked_candidates,
             self.waves,
             self.naked_pairs,
+            *self._loop_flavor(),
         )
         for mult in (1, 2, 4):
             pad = np.broadcast_to(
                 frontier._unsat_pad(self.spec), (target * mult, N, N)
             )
             np.asarray(racer(jnp.asarray(pad)))
+
+    def _loop_flavor(self):
+        """(packed, legacy_merges) for step-loop callers that trace outside
+        solve_batch (the quick-state probe, the frontier race): the same
+        --solver-config flavor the bucket programs run."""
+        legacy = bool(self.solver_overrides.get("legacy_loop"))
+        packed = False if legacy else self.solver_overrides.get("packed")
+        return packed, legacy
+
+    def solver_loop_info(self) -> dict:
+        """The resolved hot-loop configuration this engine's bucket
+        programs run (PR 7): compaction ladder (for the widest bucket),
+        descent-check period, packed-bitplane state, and whether the
+        legacy escape hatch is active. Rides warm_info()/ /metrics so a
+        serving node's active schedule is observable."""
+        if self.backend == "pallas":
+            return {"backend": "pallas"}
+        from .ops.config import resolved_loop_shape
+        from .ops.solver import _compaction_schedule
+
+        # THE same resolution the solver traces with (ops/config.py) — no
+        # parallel re-derivation that could drift from the real schedule
+        shape = resolved_loop_shape(self.spec.size, self.solver_overrides)
+        return {
+            "legacy": shape["legacy"],
+            # packed planes only run inside locked sweeps; report the
+            # bit that is actually live for this engine's config
+            "packed": shape["packed"] and bool(self.locked_candidates),
+            "compact_div": shape["div"],
+            "compact_floor": shape["floor"],
+            "compact_every": shape["every"],
+            "ladder": _compaction_schedule(
+                self.buckets[-1], shape["div"], shape["floor"]
+            ),
+        }
 
     def warm_info(self) -> dict:
         """Per-bucket warm state (the /metrics ``engine.warm`` block):
@@ -1296,6 +1370,7 @@ class SolverEngine:
                 "order": list(self._warm_order),
                 "skipped": list(self._warm_skipped),
                 "programs": len(self._programs),
+                "solver_loop": self.solver_loop_info(),
             }
             if self.device_trace_dir is not None:
                 # the --device-trace-dir capture state (ISSUE 6 satellite):
@@ -1456,6 +1531,7 @@ class SolverEngine:
         else:
             from .parallel import frontier_solve
 
+            packed, legacy = self._loop_flavor()
             solution, info = frontier_solve(
                 arr,
                 self.frontier_mesh,
@@ -1465,6 +1541,8 @@ class SolverEngine:
                 locked=self.locked_candidates,
                 waves=self.waves,
                 naked_pairs=self.naked_pairs,
+                packed=packed,
+                legacy_merges=legacy,
                 initial_states=seed_states,
             )
         return solution, dict(info, frontier=True)

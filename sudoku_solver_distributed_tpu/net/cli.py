@@ -127,6 +127,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="board edge length the engine serves (9, 16 hexadoku, or 25)",
     )
     parser.add_argument(
+        "--solver-config",
+        default=None,
+        choices=["default", "legacy"],
+        help="hot-loop preset (ops/config.SOLVER_PRESETS): 'legacy' "
+        "restores the pre-PR7 solver loop (unpacked analysis, quartering "
+        "compaction ladder) for A/B — xla backend only",
+    )
+    parser.add_argument(
         "--metrics", action="store_true", help="expose GET /metrics"
     )
     parser.add_argument(
@@ -412,6 +420,7 @@ def main(argv=None) -> None:
         "coalesce_max_batch": args.coalesce_max_batch,
         "coalesce_adaptive": args.adaptive_coalesce,
         "compile_cache_dir": args.compile_cache_dir,
+        "solver_config": args.solver_config,
     }
     if args.buckets:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))

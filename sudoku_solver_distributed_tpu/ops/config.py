@@ -88,3 +88,142 @@ CPU_SERVING_OVERRIDES = {
 def cpu_serving_config(size: int) -> dict:
     """``serving_config`` with the measured CPU-backend overrides applied."""
     return {**serving_config(size), **CPU_SERVING_OVERRIDES.get(size, {})}
+
+
+# ---------------------------------------------------------------------------
+# Hot-loop schedule (PR 7): in-jit active-set compaction + packed bitplanes.
+#
+# ``div``/``floor`` shape the compaction ladder ``[B, B//div, B//div², ...]``
+# (ops/solver._compaction_schedule); ``every`` is the descent-check period K —
+# the level loop only evaluates "few enough boards still RUNNING to drop to
+# the next ladder rung?" every K iterations, so the reduction + sort/gather
+# can be amortized on backends where they are expensive relative to a sweep.
+#
+# Measured (2026-08-03, 1 pinned CPU core, hard-9×9 4096-board corpus,
+# serving config, best-of-4):
+#   ladder   div=4 floor=64 (the pre-PR7 schedule)  4,228 pps
+#            div=2 floor=64                         5,142 pps
+#            div=2 floor=32                         6,122 pps
+#            div=2 floor=16                         6,585 pps   <- winner
+#            div=2 floor=8                          ~same, more compile
+#   period   K=1 beats K=4/8/16 (7,069 vs 6,806/5,926/5,140 pps in the
+#            nested-loop probe): on CPU a sweep costs far more than the
+#            descent reduction, so compacting at the first opportunity wins.
+#            K stays a knob for the TPU session to sweep (the sort/gather
+#            cost model is different when the stack streams from HBM).
+# The running-count trajectory explains the ladder: on the hard corpus the
+# batch collapses from 4096 RUNNING to ~500 within ~20 iterations and to
+# ~5 by iteration 100, while the stragglers run to ~540 — a quartering
+# ladder with floor 64 leaves the wide rungs paying for finished lanes.
+COMPACTION = {
+    9: dict(div=2, floor=16, every=1),
+    16: dict(div=2, floor=16, every=1),
+    25: dict(div=2, floor=16, every=1),
+}
+_COMPACTION_DEFAULT = dict(div=2, floor=16, every=1)
+
+
+def compaction_config(size: int) -> dict:
+    """Measured-best compaction ladder knobs for an N×N board."""
+    return dict(COMPACTION.get(size, _COMPACTION_DEFAULT))
+
+
+# Packed bitplane propagation (ops/propagate.py): the locked-candidate
+# (pointing + claiming) analysis runs its row pass and column pass as two
+# 16-bit bitplanes of one int32 lane — one reduction tree instead of two.
+# Exact (pure bitwise ops, no carries), so outputs are bit-identical to the
+# unpacked sweep; needs the value mask to fit 16 bits, i.e. N ≤ 16.
+# Measured (same rig as above): locked analyze sweep 1,958 → 1,350 ns/board.
+# Packing the naked/hidden-single once/twice reductions the same way was
+# measured SLOWER on CPU (the pack construction costs more than the saved
+# pass: full-packed 1,683, three-plane 9×9 variant 1,624 ns/board) — so
+# ``packed`` covers exactly the locked-elimination planes.
+PACKED_DEFAULT = {9: True, 16: True, 25: False}
+
+
+def packed_default(size: int) -> bool:
+    """Whether packed bitplane analysis is on by default for this size."""
+    return bool(PACKED_DEFAULT.get(size, size <= 16))
+
+
+# The --solver-config escape hatch (engine.py / net/cli.py / bench.py):
+# named presets mapping to solve_batch overrides. "legacy" restores the
+# pre-PR7 hot loop end to end — unpacked analysis, scatter-based step
+# merges, the quartering floor-64 ladder with full-permute compaction —
+# so any A/B (bench.py --mode hotloop) measures exactly the old loop.
+SOLVER_PRESETS = {
+    "default": {},
+    "legacy": {"legacy_loop": True},
+}
+
+
+# The keys a --solver-config dict may carry: exactly the hot-loop knobs.
+# Engine-owned solver knobs (waves, locked_candidates, naked_pairs,
+# max_depth, max_iters) are deliberately NOT overridable here — the engine
+# passes them explicitly and a duplicate would only surface as an opaque
+# TypeError deep inside the jit trace.
+SOLVER_OVERRIDE_KEYS = frozenset(
+    ("packed", "compact_div", "compact_floor", "compact_every",
+     "legacy_loop")
+)
+
+
+def resolve_solver_overrides(config) -> dict:
+    """Normalize a --solver-config value (preset name | dict | None) into
+    ``solve_batch`` keyword overrides. Unknown dict keys fail HERE, at
+    configuration time, with the allowed set in the message — not at the
+    first device call."""
+    if config is None:
+        return {}
+    if isinstance(config, str):
+        try:
+            return dict(SOLVER_PRESETS[config])
+        except KeyError:
+            raise ValueError(
+                f"unknown solver config preset {config!r}; "
+                f"have {sorted(SOLVER_PRESETS)}"
+            ) from None
+    config = dict(config)
+    unknown = set(config) - SOLVER_OVERRIDE_KEYS
+    if unknown:
+        raise ValueError(
+            f"unknown solver config override(s) {sorted(unknown)}; "
+            f"allowed: {sorted(SOLVER_OVERRIDE_KEYS)}"
+        )
+    return config
+
+
+# The legacy (pre-PR7) loop shape, in one place: ops/solver._solve_impl
+# traces it and engine.solver_loop_info()/_program_config() key AOT
+# artifacts on it — they must agree by construction, not by parallel
+# maintenance.
+LEGACY_LOOP_SHAPE = {
+    "legacy": True,
+    "packed": False,
+    "div": 4,
+    "floor": 64,
+    "every": 1,
+}
+
+
+def resolved_loop_shape(size: int, overrides: dict) -> dict:
+    """The hot-loop shape ``solve_batch`` will actually trace for these
+    overrides: {legacy, packed, div, floor, every}. THE single resolution
+    site — both the solver (ops/solver._solve_impl) and the engine's
+    observability/AOT key (engine.solver_loop_info) consume it, so the
+    schedule that runs is provably the one reported and keyed."""
+    if overrides.get("legacy_loop"):
+        return dict(LEGACY_LOOP_SHAPE)
+    cc = compaction_config(size)
+
+    def pick(key, default):
+        v = overrides.get(key)
+        return default if v is None else v
+
+    return {
+        "legacy": False,
+        "packed": bool(pick("packed", packed_default(size))),
+        "div": pick("compact_div", cc["div"]),
+        "floor": pick("compact_floor", cc["floor"]),
+        "every": pick("compact_every", cc["every"]),
+    }

@@ -49,7 +49,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .spec import BoardSpec
-from .solver import OVERFLOW, RUNNING, SOLVED, UNSAT, SolveResult
+from .solver import (
+    OVERFLOW,
+    RUNNING,
+    SOLVED,
+    UNSAT,
+    LoopStats,
+    SolveResult,
+    _merge_stats,
+)
 
 _BIG = 1 << 30  # plain int: jnp scalars would be captured closure constants
 
@@ -187,13 +195,18 @@ def _make_kernel(spec: BoardSpec, L: int, D: int, max_iters: int):
             return cand, assign, contra, solved, pc_cand
 
         def cond(carry):
-            (g, sg, sc, sm, depth, status, guesses, vals, it) = carry
+            (g, sg, sc, sm, depth, status, guesses, vals, idle, it) = carry
             return ((status == RUNNING).any()) & (it < max_iters)
 
         def body(carry):
-            (g, sg, sc, sm, depth, status, guesses, vals, it) = carry
+            (g, sg, sc, sm, depth, status, guesses, vals, idle, it) = carry
             cand, assign, contra, solved, pc_cand = analyze(g)
             running = (status == RUNNING).astype(jnp.int32)   # (1, L)
+            # idle-lane accounting (ops/solver.LoopStats mirror): lanes
+            # stepped while already finished — the waste the per-block
+            # early exit bounds to one block's straggler tail (pad lanes
+            # of a ragged batch count too; they are genuinely swept)
+            idle = idle + (1 - running)
 
             status1 = jnp.where(
                 (running * solved) == 1, SOLVED, status
@@ -274,6 +287,7 @@ def _make_kernel(spec: BoardSpec, L: int, D: int, max_iters: int):
                 g1, sg1, sc1, sm1, depth1, status1,
                 guesses + do_branch,
                 vals + running,
+                idle,
                 it + 1,
             )
 
@@ -287,9 +301,10 @@ def _make_kernel(spec: BoardSpec, L: int, D: int, max_iters: int):
             jnp.full((1, L), RUNNING, jnp.int32),
             jnp.zeros((1, L), jnp.int32),
             jnp.zeros((1, L), jnp.int32),
+            jnp.zeros((1, L), jnp.int32),
             jnp.int32(0),
         )
-        (g, sg, sc, sm, depth, status, guesses, vals, it) = (
+        (g, sg, sc, sm, depth, status, guesses, vals, idle, it) = (
             jax.lax.while_loop(cond, body, init)
         )
         # close the last-step gap exactly like solver.finalize_status
@@ -302,7 +317,8 @@ def _make_kernel(spec: BoardSpec, L: int, D: int, max_iters: int):
             [
                 status, guesses, vals,
                 jnp.full((1, L), it, jnp.int32),
-                jnp.zeros((4, L), jnp.int32),
+                idle,
+                jnp.zeros((3, L), jnp.int32),
             ],
             axis=0,
         )
@@ -330,12 +346,13 @@ def _fit_depth(spec: BoardSpec, block: int) -> int:
 def _retry_overflow_deep(
     grid: jnp.ndarray,
     res: SolveResult,
+    stats: LoopStats,
     spec: BoardSpec,
     depth: int,
     block: int,
     max_iters: int,
     interpret: bool,
-) -> SolveResult:
+) -> tuple:
     """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
 
     Mirror of ops.solver._retry_overflow for the pallas backend: the whole
@@ -355,12 +372,12 @@ def _retry_overflow_deep(
         g2 = jnp.where(
             need[:, None, None], grid.astype(jnp.int32), pad_board(spec)
         )
-        r2 = _solve_stage(
+        r2, s2 = _solve_stage(
             g2, spec, depth, block, max_iters, interpret
         )
-        return merge_retry_result(need, res, r2)
+        return merge_retry_result(need, res, r2), _merge_stats(stats, s2)
 
-    return jax.lax.cond(need.any(), do, lambda _: res, None)
+    return jax.lax.cond(need.any(), do, lambda _: (res, stats), None)
 
 
 def _solve_stage(
@@ -370,7 +387,7 @@ def _solve_stage(
     block: int,
     max_iters: int,
     interpret: bool,
-) -> SolveResult:
+) -> tuple:
     """One staging level at a flat ``depth``: the pallas kernel while its
     stack fits the VMEM budget, the XLA solver (HBM-streamed stack) past it.
     locked_candidates/waves stay off in the fallback so both backends search
@@ -378,11 +395,13 @@ def _solve_stage(
     if _stack_bytes(depth, spec, block) <= _VMEM_STACK_BUDGET:
         return solve_batch_pallas(
             grid, spec, block=block, max_depth=depth,
-            max_iters=max_iters, interpret=interpret,
+            max_iters=max_iters, interpret=interpret, return_stats=True,
         )
     from .solver import solve_batch as solve_batch_xla
 
-    return solve_batch_xla(grid, spec, max_iters=max_iters, max_depth=depth)
+    return solve_batch_xla(
+        grid, spec, max_iters=max_iters, max_depth=depth, return_stats=True
+    )
 
 
 def solve_batch_pallas(
@@ -393,8 +412,16 @@ def solve_batch_pallas(
     max_depth: Optional[int | tuple] = None,
     max_iters: int = 4096,
     interpret: bool = False,
-) -> SolveResult:
+    return_stats: bool = False,
+):
     """Solve a (B, N, N) batch with the VMEM-resident pallas kernel.
+
+    ``return_stats`` also returns an ops/solver.LoopStats: here
+    ``lane_steps`` counts lanes swept (each lane pays its block's
+    iteration count — pad lanes of a ragged batch included, they are
+    genuinely swept) and ``idle_lane_steps`` the lanes stepped while
+    already finished; the per-block early exit is the kernel's compaction
+    analog, so idle is bounded by each block's own straggler tail.
 
     Functionally equivalent to ops.solver.solve_batch (same statuses, same
     solutions; iteration counts differ — here ``iters`` is the max over
@@ -437,15 +464,15 @@ def solve_batch_pallas(
         # every stage — including the first — honors the VMEM budget
         # (_solve_stage routes over-budget depths to the XLA solver); a
         # too-big block can make even _fit_depth's floor of 8 over budget
-        res = _solve_stage(
+        res, stats = _solve_stage(
             grid.astype(jnp.int32), spec, depths[0], block, max_iters,
             interpret,
         )
         for d in depths[1:]:
-            res = _retry_overflow_deep(
-                grid, res, spec, d, block, max_iters, interpret
+            res, stats = _retry_overflow_deep(
+                grid, res, stats, spec, d, block, max_iters, interpret
             )
-        return res
+        return (res, stats) if return_stats else res
     # Same default depth budget as the XLA path (spec.max_depth) so the two
     # backends report identical OVERFLOW verdicts.
     D = max_depth if max_depth is not None else spec.max_depth
@@ -490,7 +517,7 @@ def solve_batch_pallas(
     )(cells_major, jnp.asarray(U), jnp.asarray(UT))
 
     grids = grid_cm[:C].T[:B]                      # (B, C)
-    return SolveResult(
+    res = SolveResult(
         grid=grids.reshape(B, N, N),
         solved=meta[0, :B] == SOLVED,
         status=meta[0, :B],
@@ -498,3 +525,10 @@ def solve_batch_pallas(
         validations=meta[2, :B],
         iters=meta[3].max(),
     )
+    if not return_stats:
+        return res
+    stats = LoopStats(
+        lane_steps=meta[3].sum(),
+        idle_lane_steps=meta[4].sum(),
+    )
+    return res, stats

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .spec import BoardSpec
+from .config import resolved_loop_shape
 from .encode import mask_to_value
 from .propagate import analyze
 
@@ -40,6 +41,45 @@ class SolveResult(NamedTuple):
     guesses: jnp.ndarray     # (B,) int32 — speculative branches taken
     validations: jnp.ndarray  # (B,) int32 — analysis sweeps while active
     iters: jnp.ndarray       # () int32 — lockstep iterations executed
+
+
+class LoopStats(NamedTuple):
+    """Machine-independent work counters for the hot loop (the compaction
+    proof artifact of ``bench.py --mode hotloop``; optional via
+    ``solve_batch(..., return_stats=True)``).
+
+    ``lane_steps`` counts board-lanes swept: each lockstep iteration adds
+    the width of the slice it ran on. ``idle_lane_steps`` counts the subset
+    of those lanes that were already finished (SOLVED/UNSAT/OVERFLOW) when
+    the iteration ran — the waste active-set compaction exists to remove.
+    With the compacted loop, idle lanes accrue only between a board's
+    finish and the next ladder descent; the legacy full-batch loop pays
+    them for the whole straggler tail.
+    """
+
+    lane_steps: jnp.ndarray       # () int32
+    idle_lane_steps: jnp.ndarray  # () int32
+
+
+def _zero_stats() -> "LoopStats":
+    return LoopStats(jnp.int32(0), jnp.int32(0))
+
+
+def _count_entry(stats: "LoopStats", status: jnp.ndarray) -> "LoopStats":
+    """Account one lockstep iteration over a slice whose per-board status is
+    ``status`` (counted at iteration entry: a board that finishes in this
+    very step was still useful work)."""
+    return LoopStats(
+        lane_steps=stats.lane_steps + status.shape[0],
+        idle_lane_steps=stats.idle_lane_steps
+        + (status != RUNNING).sum().astype(jnp.int32),
+    )
+
+
+def _merge_stats(a: "LoopStats", b: "LoopStats") -> "LoopStats":
+    return LoopStats(
+        a.lane_steps + b.lane_steps, a.idle_lane_steps + b.idle_lane_steps
+    )
 
 
 class _State(NamedTuple):
@@ -76,6 +116,8 @@ def _step(
     waves: int = 1,
     light_waves: bool = False,
     naked_pairs: bool | None = None,
+    packed: bool | None = None,
+    legacy_merges: bool = False,
 ) -> _State:
     B, C = state.grid.shape
     D = state.stack_mask.shape[1]
@@ -86,7 +128,7 @@ def _step(
     # (ops/propagate.py): candidates, forced singles, contradiction, solved.
     a = analyze(
         state.grid.reshape(B, N, N), spec, locked=locked,
-        naked_pairs=naked_pairs,
+        naked_pairs=naked_pairs, packed=packed,
     )
     cand = a.cand.reshape(B, C)
     assign = a.assign.reshape(B, C)
@@ -112,7 +154,25 @@ def _step(
     new_status = jnp.where(overflow, OVERFLOW, new_status)
 
     push_slot = jnp.clip(state.depth, 0, D - 1)
-    branched_grid = state.grid.at[b, mrv_cell].set(mask_to_value(guess_bit))
+
+    # Single-cell writes and per-frame stack-slot updates run as one-hot
+    # masked merges rather than scatters: an XLA CPU scatter serializes per
+    # index (measured 158 vs 32 ns/board for a one-element row write), and
+    # on TPU a masked select over lanes is the natural shape anyway.
+    # ``legacy_merges`` keeps the scatter forms so --solver-config=legacy
+    # A/Bs the exact pre-PR7 hot loop.
+    iota_c = jnp.arange(C, dtype=jnp.int32)
+    iota_d = jnp.arange(D, dtype=jnp.int32)
+    if legacy_merges:
+        branched_grid = state.grid.at[b, mrv_cell].set(
+            mask_to_value(guess_bit)
+        )
+    else:
+        branched_grid = jnp.where(
+            iota_c[None, :] == mrv_cell[:, None],
+            mask_to_value(guess_bit)[:, None],
+            state.grid,
+        )
 
     # --- path 3: backtrack (contradiction)
     do_bt = act & contra
@@ -128,7 +188,14 @@ def _step(
     # retry: restore snapshot, take next untried bit at the same cell.
     bt_retry = do_bt & ~empty_stack & ~exhausted
     retry_bit = top_mask & -top_mask
-    retry_grid = top_grid.at[b, top_cell].set(mask_to_value(retry_bit))
+    if legacy_merges:
+        retry_grid = top_grid.at[b, top_cell].set(mask_to_value(retry_bit))
+    else:
+        retry_grid = jnp.where(
+            iota_c[None, :] == top_cell[:, None],
+            mask_to_value(retry_bit)[:, None],
+            top_grid,
+        )
     new_status = jnp.where(do_bt & empty_stack, UNSAT, new_status)
 
     # --- merge paths
@@ -137,6 +204,8 @@ def _step(
     grid = jnp.where(do_branch[:, None], branched_grid, grid)
     grid = jnp.where(bt_retry[:, None], retry_grid, grid)
 
+    # the grid snapshot push stays a scatter: the masked-merge form would
+    # touch the whole (B, D, C) stack every iteration (D× the traffic)
     stack_grid = state.stack_grid.at[b, push_slot].set(
         jnp.where(
             do_branch[:, None],
@@ -144,16 +213,27 @@ def _step(
             state.stack_grid[b, push_slot],
         )
     )
-    stack_cell = state.stack_cell.at[b, push_slot].set(
-        jnp.where(do_branch, mrv_cell, state.stack_cell[b, push_slot])
-    )
     pushed_mask = mrv_mask & ~guess_bit
-    stack_mask = state.stack_mask.at[b, push_slot].set(
-        jnp.where(do_branch, pushed_mask, state.stack_mask[b, push_slot])
-    )
-    stack_mask = stack_mask.at[b, top].set(
-        jnp.where(bt_retry, top_mask & ~retry_bit, stack_mask[b, top])
-    )
+    if legacy_merges:
+        stack_cell = state.stack_cell.at[b, push_slot].set(
+            jnp.where(do_branch, mrv_cell, state.stack_cell[b, push_slot])
+        )
+        stack_mask = state.stack_mask.at[b, push_slot].set(
+            jnp.where(do_branch, pushed_mask, state.stack_mask[b, push_slot])
+        )
+        stack_mask = stack_mask.at[b, top].set(
+            jnp.where(bt_retry, top_mask & ~retry_bit, stack_mask[b, top])
+        )
+    else:
+        push_hot = (iota_d[None, :] == push_slot[:, None]) & do_branch[:, None]
+        stack_cell = jnp.where(push_hot, mrv_cell[:, None], state.stack_cell)
+        stack_mask = jnp.where(
+            push_hot, pushed_mask[:, None], state.stack_mask
+        )
+        retry_hot = (iota_d[None, :] == top[:, None]) & bt_retry[:, None]
+        stack_mask = jnp.where(
+            retry_hot, (top_mask & ~retry_bit)[:, None], stack_mask
+        )
 
     depth = state.depth + do_branch.astype(jnp.int32) - bt_pop.astype(jnp.int32)
     validations = state.validations + running.astype(jnp.int32)
@@ -168,7 +248,7 @@ def _step(
     for _ in range(waves - 1):
         aw = analyze(
             grid.reshape(B, N, N), spec, locked=locked and not light_waves,
-            naked_pairs=naked_pairs,
+            naked_pairs=naked_pairs, packed=packed,
         )
         assign_w = aw.assign.reshape(B, C)
         still_running = (new_status == RUNNING)
@@ -229,9 +309,18 @@ def step(
     waves: int = 1,
     light_waves: bool = False,
     naked_pairs: bool | None = None,
+    packed: bool | None = None,
+    legacy_merges: bool = False,
 ) -> _State:
-    """One lockstep solver iteration over the batch (public; see init_state)."""
-    return _step(state, spec, locked, waves, light_waves, naked_pairs)
+    """One lockstep solver iteration over the batch (public; see init_state).
+
+    ``legacy_merges`` keeps the pre-PR7 scatter-form merges so callers
+    that run the step loop themselves (the engine's quick-state probe)
+    can honor --solver-config=legacy end to end."""
+    return _step(
+        state, spec, locked, waves, light_waves, naked_pairs, packed,
+        legacy_merges,
+    )
 
 
 def finalize_status(state: _State, spec: BoardSpec) -> _State:
@@ -282,15 +371,34 @@ def _write_boards(state: _State, sub: _State, count: int) -> _State:
     )
 
 
+def _put_boards(state: _State, sub: _State, idx: jnp.ndarray) -> _State:
+    """Scatter ``sub`` back over the board rows named by ``idx`` (unique
+    indices — the compaction gather's inverse)."""
+    return _State(
+        grid=state.grid.at[idx].set(sub.grid),
+        stack_grid=state.stack_grid.at[idx].set(sub.stack_grid),
+        stack_cell=state.stack_cell.at[idx].set(sub.stack_cell),
+        stack_mask=state.stack_mask.at[idx].set(sub.stack_mask),
+        depth=state.depth.at[idx].set(sub.depth),
+        status=state.status.at[idx].set(sub.status),
+        guesses=state.guesses.at[idx].set(sub.guesses),
+        validations=state.validations.at[idx].set(sub.validations),
+        iters=sub.iters,
+    )
+
+
 def _run_widened(
     state: _State,
+    stats: LoopStats,
     spec: BoardSpec,
     max_iters: int,
     locked: bool = False,
     waves: int = 1,
     light_waves: bool = False,
     naked_pairs: bool | None = None,
-) -> _State:
+    packed: bool | None = None,
+    legacy: bool = False,
+) -> tuple:
     """Race the pathological tail: restart each still-RUNNING board from its
     search root and explore all top-level candidates of its MRV cell as
     parallel children.
@@ -326,7 +434,8 @@ def _run_widened(
     )
 
     a = analyze(
-        root.reshape(R, N, N), spec, locked=locked, naked_pairs=naked_pairs
+        root.reshape(R, N, N), spec, locked=locked, naked_pairs=naked_pairs,
+        packed=packed,
     )
     cand = a.cand.reshape(R, C)
     cell, cmask = _mrv_cell(root, cand)                       # (R,), (R,)
@@ -355,14 +464,20 @@ def _run_widened(
         st = ws.status.reshape(R, N)
         return ((st == SOLVED).any(axis=1)) | (~(st == RUNNING).any(axis=1))
 
-    def cond(ws):
+    def cond(carry):
+        ws, _ = carry
         return (~parents_done(ws)).any() & (ws.iters < max_iters)
 
-    w = jax.lax.while_loop(
-        cond,
-        lambda ws: _step(ws, spec, locked, waves, light_waves, naked_pairs),
-        w,
-    )
+    def body(carry):
+        ws, st = carry
+        st = _count_entry(st, ws.status)
+        return (
+            _step(ws, spec, locked, waves, light_waves, naked_pairs,
+                  packed, legacy),
+            st,
+        )
+
+    w, stats = jax.lax.while_loop(cond, body, (w, stats))
     w = finalize_status(w, spec)
 
     st = w.status.reshape(R, N)
@@ -404,31 +519,49 @@ def _run_widened(
         validations=state.validations + jnp.where(running, wv, 0),
         depth=jnp.where(running, 0, state.depth),
         iters=w.iters,
-    )
+    ), stats
 
 
 def _run_compacted(
     state: _State,
+    stats: LoopStats,
     caps: list,
     spec: BoardSpec,
     max_iters: int,
+    every: int = 1,
     widen_after: int | None = None,
     locked: bool = False,
     waves: int = 1,
     light_waves: bool = False,
     naked_pairs: bool | None = None,
-) -> _State:
-    """Run the lockstep loop with hierarchical active-board compaction.
+    packed: bool | None = None,
+    legacy: bool = False,
+) -> tuple:
+    """Run the lockstep loop with in-jit hierarchical active-set compaction.
 
     The lockstep loop's cost per iteration is proportional to the batch size,
     but iteration *count* is set by the hardest board — the long tail runs at
     full-batch cost. So: run the full batch only until at most ``caps[1]``
-    boards are still RUNNING, stably sort the running boards to the front
-    (argsort on a bool key — a bijection, nothing is lost), slice off that
-    prefix, and recurse on the slice. The tail of hard boards then iterates at
-    1/4, 1/16, ... of the batch cost. Static shapes throughout: ``caps`` is a
-    Python list fixed at trace time, so the whole schedule compiles into one
-    jitted graph.
+    boards are still RUNNING, stably sort the still-RUNNING boards' indices
+    to the front (argsort on a bool key), gather that dense prefix, and
+    recurse on the slice; on the way back out the slice scatters over the
+    rows it came from (``_put_boards``). The tail of hard boards then
+    iterates at caps[1]/caps[0], caps[2]/caps[0], ... of the batch cost.
+    Static shapes throughout: ``caps`` is a Python list fixed at trace time,
+    so the whole schedule compiles into one jitted graph.
+
+    ``every`` is the descent-check period K (ops/config.COMPACTION): the
+    level loop evaluates the "few enough RUNNING boards to descend?"
+    reduction only at iteration numbers divisible by K, amortizing the
+    check + sort/gather where they are expensive relative to a sweep.
+    K=1 (the measured CPU winner — a sweep costs far more than the
+    reduction) checks every iteration, exactly the legacy cadence.
+
+    ``legacy`` restores the pre-PR7 mechanics for A/B: full-batch permute +
+    inverse permute at every level boundary (instead of the prefix
+    gather/scatter, which moves only the slice that keeps running — the
+    guess-stack snapshots are the state's dominant traffic) and the
+    scatter-form step merges.
 
     At the final level, boards still RUNNING after ``widen_after`` further
     iterations are handed to ``_run_widened`` — the serial-depth-bound
@@ -436,72 +569,89 @@ def _run_compacted(
     """
     running_of = lambda s: s.status == RUNNING  # noqa: E731
 
+    def do_step(s: _State) -> _State:
+        return _step(
+            s, spec, locked, waves, light_waves, naked_pairs, packed, legacy
+        )
+
+    def body(carry):
+        s, st = carry
+        return do_step(s), _count_entry(st, s.status)
+
     if len(caps) == 1:
-        def cond(s: _State):
+        def cond(carry):
+            s, _ = carry
             return running_of(s).any() & (s.iters < max_iters)
 
         if widen_after is None:
-            return jax.lax.while_loop(
-                cond,
-                lambda s: _step(
-                    s, spec, locked, waves, light_waves, naked_pairs
-                ),
-                state,
-            )
+            return jax.lax.while_loop(cond, body, (state, stats))
 
         grace_end = jnp.minimum(state.iters + widen_after, max_iters)
 
-        def grace_cond(s: _State):
+        def grace_cond(carry):
+            s, _ = carry
             return running_of(s).any() & (s.iters < grace_end)
 
-        state = jax.lax.while_loop(
-            grace_cond,
-            lambda s: _step(
-                s, spec, locked, waves, light_waves, naked_pairs
-            ),
-            state,
-        )
+        state, stats = jax.lax.while_loop(grace_cond, body, (state, stats))
         return jax.lax.cond(
             running_of(state).any(),
-            lambda s: _run_widened(
-                s, spec, max_iters, locked, waves, light_waves, naked_pairs
+            lambda c: _run_widened(
+                c[0], c[1], spec, max_iters, locked, waves, light_waves,
+                naked_pairs, packed, legacy,
             ),
-            lambda s: s,
-            state,
+            lambda c: c,
+            (state, stats),
         )
 
     next_cap = caps[1]
 
-    def cond(s: _State):
-        # running.sum() > next_cap (≥ 64) subsumes running.any()
-        return (s.iters < max_iters) & (running_of(s).sum() > next_cap)
+    def cond(carry):
+        s, _ = carry
+        # running.sum() > next_cap (≥ the ladder floor) subsumes
+        # running.any(); with K > 1 the count check is only consulted at
+        # K-divisible iterations, and the (cnt > 0) term keeps the loop
+        # from idling on a finished batch until the next boundary.
+        cnt = running_of(s).sum()
+        descend_ok = cnt > next_cap
+        if every > 1:
+            descend_ok = descend_ok | ((cnt > 0) & (s.iters % every != 0))
+        return (s.iters < max_iters) & descend_ok
 
-    state = jax.lax.while_loop(
-        cond,
-        lambda s: _step(s, spec, locked, waves, light_waves, naked_pairs),
-        state,
-    )
+    state, stats = jax.lax.while_loop(cond, body, (state, stats))
 
     # Stable sort: RUNNING boards (key 0) to the front, finished (key 1) after.
     perm = jnp.argsort((~running_of(state)).astype(jnp.int32), stable=True)
-    inv = jnp.argsort(perm)
-    permuted = _take_boards(state, perm)
-    sub = jax.tree.map(
-        lambda x: x[:next_cap] if x.ndim else x, permuted
+    if legacy:
+        inv = jnp.argsort(perm)
+        permuted = _take_boards(state, perm)
+        sub = jax.tree.map(
+            lambda x: x[:next_cap] if x.ndim else x, permuted
+        )
+        sub, stats = _run_compacted(
+            sub, stats, caps[1:], spec, max_iters, every, widen_after,
+            locked, waves, light_waves, naked_pairs, packed, legacy,
+        )
+        merged = _write_boards(permuted, sub, next_cap)
+        return _take_boards(merged, inv), stats
+    # Prefix gather: move only the boards that keep running (and scatter
+    # them back over their own rows afterwards) instead of permuting the
+    # whole batch twice — at a 4096-board level boundary that is ~4× less
+    # gather/scatter traffic on the stack snapshots, the state's bulk.
+    idx = perm[:next_cap]
+    sub = _take_boards(state, idx)
+    sub, stats = _run_compacted(
+        sub, stats, caps[1:], spec, max_iters, every, widen_after,
+        locked, waves, light_waves, naked_pairs, packed, legacy,
     )
-    sub = _run_compacted(
-        sub, caps[1:], spec, max_iters, widen_after, locked, waves,
-        light_waves, naked_pairs,
-    )
-    merged = _write_boards(permuted, sub, next_cap)
-    return _take_boards(merged, inv)
+    return _put_boards(state, sub, idx), stats
 
 
-def _compaction_schedule(B: int) -> list:
-    """[B, B//4, B//16, ...] down to a floor of 64 boards per slice."""
+def _compaction_schedule(B: int, div: int = 2, floor: int = 16) -> list:
+    """[B, B//div, B//div², ...] down to ``floor`` boards per slice
+    (defaults are the measured CPU winners — ops/config.COMPACTION)."""
     caps = [B]
-    while caps[-1] // 4 >= 64:
-        caps.append(caps[-1] // 4)
+    while caps[-1] // div >= floor:
+        caps.append(caps[-1] // div)
     return caps
 
 
@@ -539,16 +689,14 @@ def merge_retry_result(
 def _retry_overflow(
     grid: jnp.ndarray,
     res: SolveResult,
+    stats: LoopStats,
     spec: BoardSpec,
     depth: int,
     max_iters: int,
     compact: bool,
     widen_after: int | None,
-    locked: bool = False,
-    waves: int = 1,
-    light_waves: bool = False,
-    naked_pairs: bool | None = None,
-) -> SolveResult:
+    kw: dict,
+) -> tuple:
     """Re-solve only the OVERFLOW boards of ``res`` with a deeper stack.
 
     The whole retry sits behind a ``lax.cond`` on "any overflow", so a batch
@@ -557,7 +705,9 @@ def _retry_overflow(
     Non-overflow lanes are replaced by an instantly-UNSAT pad board (the
     compaction loop drops them after one iteration) and keep their original
     result; overflow lanes get the retry's result, with work counters
-    accumulated across stages.
+    accumulated across stages. ``kw`` carries the remaining loop knobs
+    (locked_candidates/waves/light_waves/naked_pairs/packed/compact_*/
+    legacy_loop) unchanged into the retry stage.
     """
     need = res.status == OVERFLOW
 
@@ -565,15 +715,13 @@ def _retry_overflow(
         g2 = jnp.where(
             need[:, None, None], grid.astype(jnp.int32), pad_board(spec)
         )
-        r2 = solve_batch(
+        r2, s2 = _solve_impl(
             g2, spec, max_iters=max_iters, max_depth=depth,
-            compact=compact, widen_after=widen_after,
-            locked_candidates=locked, waves=waves,
-            light_waves=light_waves, naked_pairs=naked_pairs,
+            compact=compact, widen_after=widen_after, **kw,
         )
-        return merge_retry_result(need, res, r2)
+        return merge_retry_result(need, res, r2), _merge_stats(stats, s2)
 
-    return jax.lax.cond(need.any(), do, lambda _: res, None)
+    return jax.lax.cond(need.any(), do, lambda _: (res, stats), None)
 
 
 def solve_batch(
@@ -588,7 +736,13 @@ def solve_batch(
     waves: int = 1,
     light_waves: bool = False,
     naked_pairs: bool | None = None,
-) -> SolveResult:
+    packed: bool | None = None,
+    compact_div: int | None = None,
+    compact_floor: int | None = None,
+    compact_every: int | None = None,
+    legacy_loop: bool = False,
+    return_stats: bool = False,
+):
     """Solve a batch of boards to completion (or proven unsatisfiability).
 
     Args:
@@ -659,32 +813,101 @@ def solve_batch(
         iteration bill changes — so this is an opt-in for known-solvable
         batch workloads, never the serving default.
 
+      packed: bitplane implementation of the locked-candidate analysis
+        pass (ops/propagate.py): the row and column passes ride two
+        16-bit planes of one int32 lane — exact, bit-identical outputs,
+        measured ~1.45× cheaper locked sweeps on CPU. None resolves the
+        per-size default (on for N ≤ 16; a 25-value mask does not fit a
+        plane).
+      compact_div / compact_floor / compact_every: compaction ladder
+        divisor, floor, and descent-check period K (None → the measured
+        per-size defaults in ops/config.COMPACTION; see _run_compacted).
+      legacy_loop: restore the pre-PR7 hot loop end to end — unpacked
+        analysis, scatter-form step merges, the quartering floor-64
+        ladder with full-permute level boundaries. The A/B arm of
+        ``bench.py --mode hotloop``; ~1.67× slower on the hard-9×9 CPU
+        bench at batch 4096 (benchmarks/hotloop_pr7.json).
+      return_stats: also return a ``LoopStats`` (lane_steps /
+        idle_lane_steps work counters — the machine-independent
+        compaction proof).
+
     Jit-safe and vmap/shard_map-friendly (static shapes throughout).
     """
+    res, stats = _solve_impl(
+        grid, spec, max_iters=max_iters, max_depth=max_depth,
+        compact=compact, widen_after=widen_after,
+        locked_candidates=locked_candidates, waves=waves,
+        light_waves=light_waves, naked_pairs=naked_pairs, packed=packed,
+        compact_div=compact_div, compact_floor=compact_floor,
+        compact_every=compact_every, legacy_loop=legacy_loop,
+    )
+    return (res, stats) if return_stats else res
+
+
+def _solve_impl(
+    grid: jnp.ndarray,
+    spec: BoardSpec,
+    *,
+    max_iters: int,
+    max_depth,
+    compact: bool,
+    widen_after: int | None,
+    locked_candidates: bool,
+    waves: int,
+    light_waves: bool,
+    naked_pairs: bool | None,
+    packed: bool | None,
+    compact_div: int | None,
+    compact_floor: int | None,
+    compact_every: int | None,
+    legacy_loop: bool,
+) -> tuple:
+    kw = dict(
+        locked_candidates=locked_candidates, waves=waves,
+        light_waves=light_waves, naked_pairs=naked_pairs, packed=packed,
+        compact_div=compact_div, compact_floor=compact_floor,
+        compact_every=compact_every, legacy_loop=legacy_loop,
+    )
     if isinstance(max_depth, (tuple, list)):
         depths = tuple(max_depth)
-        res = solve_batch(
+        res, stats = _solve_impl(
             grid, spec, max_iters=max_iters, max_depth=depths[0],
-            compact=compact, widen_after=widen_after,
-            locked_candidates=locked_candidates, waves=waves,
-            light_waves=light_waves, naked_pairs=naked_pairs,
+            compact=compact, widen_after=widen_after, **kw,
         )
         for d in depths[1:]:
-            res = _retry_overflow(
-                grid, res, spec, d, max_iters, compact, widen_after,
-                locked_candidates, waves, light_waves, naked_pairs,
+            res, stats = _retry_overflow(
+                grid, res, stats, spec, d, max_iters, compact, widen_after,
+                kw,
             )
-        return res
+        return res, stats
 
     B = grid.shape[0]
     state = init_state(grid, spec, max_depth)
 
-    caps = _compaction_schedule(B) if compact else [B]
+    # ONE resolution site for the loop shape (ops/config.py): the engine's
+    # AOT artifact key and warm_info exposure resolve through the same
+    # function, so the schedule that traces here is the one they describe.
+    shape = resolved_loop_shape(
+        spec.size,
+        {
+            "legacy_loop": legacy_loop,
+            "packed": packed,
+            "compact_div": compact_div,
+            "compact_floor": compact_floor,
+            "compact_every": compact_every,
+        },
+    )
+    caps = (
+        _compaction_schedule(B, shape["div"], shape["floor"])
+        if compact
+        else [B]
+    )
     if widen_after is not None and caps[-1] * spec.size > 8192:
         widen_after = None  # see docstring: bound the widened batch's memory
-    state = _run_compacted(
-        state, caps, spec, max_iters, widen_after, locked_candidates, waves,
-        light_waves, naked_pairs,
+    state, stats = _run_compacted(
+        state, _zero_stats(), caps, spec, max_iters, shape["every"],
+        widen_after, locked_candidates, waves, light_waves, naked_pairs,
+        shape["packed"], legacy_loop,
     )
     state = finalize_status(state, spec)
 
@@ -696,4 +919,4 @@ def solve_batch(
         guesses=state.guesses,
         validations=state.validations,
         iters=state.iters,
-    )
+    ), stats

@@ -9,6 +9,20 @@ every unit of every board in a batch in one fused XLA computation.
 Semantics follow the *strict* checker (sum == N(N+1)/2 AND all values
 distinct, reference sudoku.py:85, 95-98) — the weak sum-only fork in
 node.py:97-114 is a reference defect we do not reproduce.
+
+PR 7 (fused propagate+validate): the unit checks run on the same saturating
+once/twice bitmask reductions the propagation sweep uses
+(ops/propagate._once_twice) instead of their own (B, N, N, V) one-hot
+histograms — a unit is a permutation of 1..N iff its used-mask is the full
+mask AND its duplicate-mask is empty (N cells can only cover all N value
+bits without repetition by holding each exactly once; empty and
+out-of-range cells contribute no bits, so either also fails the full-mask
+test). That makes the API layer's per-answer validation
+(net/solver_api.py) the same handful of wide integer ops per unit the
+solver's own ``analyze`` pays, not an N×-wider histogram — and the
+solver's in-loop solved/contradiction verdicts (ops/propagate.analyze)
+are these exact reductions, fused into the sweep, so no separate
+validation pass runs per iteration.
 """
 
 from __future__ import annotations
@@ -16,30 +30,60 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .spec import BoardSpec
-from .encode import unit_value_counts, cell_used_mask, value_bitmask
+from .encode import cell_used_mask, value_bitmask
+from .propagate import _box_major, _once_twice
 
 
-def _unit_ok(counts: jnp.ndarray) -> jnp.ndarray:
-    """(B, N, V) counts → (B, N) bool: unit is a permutation of 1..N."""
-    return (counts == 1).all(axis=-1)
+def _unit_masks(grid: jnp.ndarray, spec: BoardSpec):
+    """Per-unit (used, dup) value bitmasks for rows / cols / boxes.
+
+    Each is (B, N) int32: ``used`` has bit v set iff value v+1 occurs in the
+    unit; ``dup`` iff it occurs more than once. The same reductions
+    ``propagate.analyze`` computes per sweep.
+
+    Out-of-range values are masked out explicitly (the same guard the
+    analyze sweep carries): a bare ``1 << (v-1)`` at v ≥ 33 is
+    implementation-defined for int32 shifts — a backend that wraps the
+    shift amount mod 32 would alias value 36 onto value 4's bit and let
+    an invalid board pass the strict checker. Masked, such a cell
+    contributes no bits and the unit fails the full-mask test, exactly
+    like the old one-hot histogram.
+    """
+    g = grid.astype(jnp.int32)
+    in_range = (g >= 1) & (g <= spec.size)
+    vmask = jnp.where(
+        in_range,
+        jnp.left_shift(jnp.int32(1), jnp.clip(g - 1, 0, 31)),
+        jnp.int32(0),
+    )
+    rows = _once_twice(vmask)
+    cols = _once_twice(vmask.swapaxes(1, 2))
+    boxes = _once_twice(_box_major(vmask, spec))
+    return rows, cols, boxes
+
+
+def _unit_ok(masks, spec: BoardSpec) -> jnp.ndarray:
+    """(used, dup) → (B, N) bool: unit is a permutation of 1..N."""
+    used, dup = masks
+    return (used == jnp.int32(spec.full_mask)) & (dup == 0)
 
 
 def check_rows(grid: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
     """(B, N) bool: row r of board b is a permutation of 1..N."""
-    rows, _, _ = unit_value_counts(grid, spec)
-    return _unit_ok(rows)
+    rows, _, _ = _unit_masks(grid, spec)
+    return _unit_ok(rows, spec)
 
 
 def check_cols(grid: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
     """(B, N) bool per column."""
-    _, cols, _ = unit_value_counts(grid, spec)
-    return _unit_ok(cols)
+    _, cols, _ = _unit_masks(grid, spec)
+    return _unit_ok(cols, spec)
 
 
 def check_boxes(grid: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
     """(B, N) bool per box (box id as in encode.box_index)."""
-    _, _, boxes = unit_value_counts(grid, spec)
-    return _unit_ok(boxes)
+    _, _, boxes = _unit_masks(grid, spec)
+    return _unit_ok(boxes, spec)
 
 
 def check_boards(grid: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
@@ -47,11 +91,11 @@ def check_boards(grid: jnp.ndarray, spec: BoardSpec) -> jnp.ndarray:
 
     Batched strict equivalent of ``Sudoku.check`` (reference sudoku.py:119-140).
     """
-    rows, cols, boxes = unit_value_counts(grid, spec)
+    rows, cols, boxes = _unit_masks(grid, spec)
     return (
-        _unit_ok(rows).all(axis=-1)
-        & _unit_ok(cols).all(axis=-1)
-        & _unit_ok(boxes).all(axis=-1)
+        _unit_ok(rows, spec).all(axis=-1)
+        & _unit_ok(cols, spec).all(axis=-1)
+        & _unit_ok(boxes, spec).all(axis=-1)
     )
 
 

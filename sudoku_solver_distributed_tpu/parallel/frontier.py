@@ -271,14 +271,21 @@ def _make_racer(
     locked: bool = False,
     waves: int = 1,
     naked_pairs: Optional[bool] = None,
+    packed: Optional[bool] = None,
+    legacy_merges: bool = False,
 ):
     """Compile the shard_map race (cached). A staged (tuple) ``max_depth``
     collapses to its deepest stage here — the single choke point, so engine
-    warmup and serving land on the same cache entry."""
+    warmup and serving land on the same cache entry. ``packed`` /
+    ``legacy_merges`` carry the engine's --solver-config loop flavor into
+    the race's step loop (bit-identical results; they exist so a
+    legacy-vs-default serving A/B measures the old loop on the escalated
+    boards too), and ride the lru_cache key like every other knob."""
     if isinstance(max_depth, (tuple, list)):
         max_depth = max(max_depth)
     return _make_racer_cached(
-        mesh, spec, max_iters, max_depth, locked, waves, naked_pairs
+        mesh, spec, max_iters, max_depth, locked, waves, naked_pairs,
+        packed, legacy_merges,
     )
 
 
@@ -291,6 +298,8 @@ def _make_racer_cached(
     locked: bool = False,
     waves: int = 1,
     naked_pairs: Optional[bool] = None,
+    packed: Optional[bool] = None,
+    legacy_merges: bool = False,
 ):
     """Compile the shard_map race: lockstep DFS with per-iteration early exit.
 
@@ -318,7 +327,10 @@ def _make_racer_cached(
 
         def body(carry):
             st, _ = carry
-            st = S.step(st, spec, locked, waves, naked_pairs=naked_pairs)
+            st = S.step(
+                st, spec, locked, waves, naked_pairs=naked_pairs,
+                packed=packed, legacy_merges=legacy_merges,
+            )
             local_hit = (st.status == S.SOLVED).any()
             found = jax.lax.psum(local_hit.astype(jnp.int32), "data") > 0
             return st, found
@@ -374,6 +386,8 @@ def frontier_solve(
     locked: bool = False,
     waves: int = 1,
     naked_pairs: Optional[bool] = None,
+    packed: Optional[bool] = None,
+    legacy_merges: bool = False,
     initial_states: Optional[np.ndarray] = None,
 ) -> Tuple[Optional[list], dict]:
     """Solve one (hard) board by racing its search subtrees across the mesh.
@@ -426,7 +440,8 @@ def frontier_solve(
         )
         states = np.concatenate([states, pad], axis=0)
     racer = _make_racer(
-        mesh, spec, max_iters, max_depth, locked, waves, naked_pairs
+        mesh, spec, max_iters, max_depth, locked, waves, naked_pairs,
+        packed, legacy_merges,
     )
     if len(mesh.devices.flatten()) > len(jax.local_devices()):
         # multi-host mesh (serving_loop.py): every host ran the same

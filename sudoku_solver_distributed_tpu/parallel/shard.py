@@ -30,6 +30,8 @@ def make_sharded_solver(
     max_iters: int = 4096,
     locked_candidates: bool = True,
     waves: int = 3,
+    packed: Optional[bool] = None,
+    legacy_loop: bool = False,
 ):
     """Compile a mesh-sharded batch solver.
 
@@ -63,9 +65,12 @@ def make_sharded_solver(
         check_vma=False,
     )
     def _solve_shard(grids):
+        # packed/legacy_loop carry the --solver-config hot-loop flavor
+        # (PR 7) so a legacy A/B covers the sharded path too
         res = solve_batch(
             grids, spec, max_iters=max_iters, max_depth=max_depth,
             locked_candidates=locked_candidates, waves=waves,
+            packed=packed, legacy_loop=legacy_loop,
         )
         stats = {
             "solved": jax.lax.psum(res.solved.sum(), "data"),

@@ -290,6 +290,106 @@ def test_throughput_fallback_timeout_yields_last_resort_line(tmp_path):
     assert "rc=137" in artifact["fallback_reason"]
 
 
+def test_tpu_window_claim_failed_report(tmp_path):
+    """--mode tpu-window must write a machine-readable window report on
+    the claim-failed exit path (rc=3) — the round-5 lost-window shape
+    becomes an artifact. Fast: the fake-closed scan burns no compile."""
+    out = tmp_path / "window_report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "tpu-window"],
+        cwd=REPO,
+        env=dict(
+            os.environ,
+            BENCH_WINDOW_FAKE_CLOSED="1",
+            BENCH_WINDOW_SCAN_BUDGET_S="2",
+            BENCH_WINDOW_SCAN_INTERVAL_S="1",
+            BENCH_WINDOW_OUT=str(out),
+        ),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+    json_lines = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert len(json_lines) == 1
+    line = json.loads(json_lines[0])
+    assert line["status"] == "claim-failed"
+    report = json.loads(out.read_text())
+    assert report["status"] == "claim-failed"
+    assert report["scan"]["probes"] >= 1 and not report["scan"]["opened"]
+    assert report["scan"]["transitions"][0]["state"] == "closed"
+    assert report["ladder"] == [] and report["reason"]
+
+
+@pytest.mark.slow
+def test_tpu_window_cpu_fallback_report(tmp_path):
+    """The CPU-fallback run (the CI-verified path): a full
+    scan→bake→ladder pass off-TPU lands status claimed-and-ran with a
+    real throughput record in the ladder."""
+    out = tmp_path / "window_report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "tpu-window"],
+        cwd=REPO,
+        env=dict(
+            os.environ,
+            BENCH_PLATFORM="cpu",
+            BENCH_BATCH="64",
+            BENCH_REPEATS="2",
+            BENCH_WINDOW_OUT=str(out),
+        ),
+        capture_output=True,
+        text=True,
+        timeout=480,
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    report = json.loads(out.read_text())
+    assert report["status"] == "claimed-and-ran"
+    assert report["scan"]["performed"] is False  # off-axon: no port scan
+    (entry,) = report["ladder"]
+    assert entry["rc"] == 0
+    check_artifact(entry["record"])
+
+
+@pytest.mark.slow
+def test_hotloop_smoke(tmp_path):
+    """--mode hotloop --smoke end to end: artifact parses, both arms
+    solve identically, compaction counters prove finished boards stop
+    iterating (the CI perf-smoke assertions, as a test)."""
+    out = tmp_path / "hotloop.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "hotloop", "--smoke"],
+        cwd=REPO,
+        env=dict(os.environ, BENCH_HOTLOOP_OUT=str(out)),
+        capture_output=True,
+        text=True,
+        timeout=480,
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    json_lines = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert len(json_lines) == 1
+    check_artifact(json.loads(json_lines[0]))
+    a = json.loads(out.read_text())
+    c = a["counters"]
+    for k in ("iters", "guesses", "validations"):
+        assert c["default"][k] == c["legacy"][k], (k, c)
+    assert c["default"]["idle_lane_steps"] < c["legacy"]["idle_lane_steps"]
+    s = a["straggler"]
+    assert s["post_compaction_idle_ok"]
+    assert s["default"]["idle_lanes_per_iter"] < s["compact_floor"] + 1
+    # legacy ladder floors at 64 lanes vs the new 16: tail idle ~4x less
+    assert (
+        s["legacy"]["idle_lanes_per_iter"]
+        > 2 * s["default"]["idle_lanes_per_iter"]
+    )
+
+
 def test_negative_child_rc_maps_to_128_plus_signal():
     """ADVICE r3: a SIGKILLed child must surface as 128+signal, not an
     aliased 8-bit wraparound like 247."""

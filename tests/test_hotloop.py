@@ -1,0 +1,271 @@
+"""PR 7 hot-loop tests: compaction/packed parity, work-counter proofs, and
+the golden-counter perf regression guard.
+
+Parity contract (the acceptance bar for default-on): the compacted
+prefix-gather loop, the legacy loop, and the packed/unpacked analysis
+variants must produce BIT-IDENTICAL statuses and grids — compaction only
+reorders which lanes ride together, and bitplane packing is pure bitwise
+arithmetic, so any divergence is a bug, not noise.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.models import generate_batch
+from sudoku_solver_distributed_tpu.ops import (
+    SPEC_9,
+    solve_batch,
+    spec_for_size,
+)
+from sudoku_solver_distributed_tpu.ops.config import (
+    compaction_config,
+    packed_default,
+    resolve_solver_overrides,
+    serving_config,
+)
+from sudoku_solver_distributed_tpu.ops.propagate import analyze
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _corpus(name, n=None):
+    boards = np.load(os.path.join(REPO, "benchmarks", name))["boards"]
+    return boards if n is None else boards[:n]
+
+
+def _solve(boards, size, max_iters, **kw):
+    spec = spec_for_size(size)
+    cfg = {**serving_config(size), "max_iters": max_iters}
+    res = jax.jit(
+        lambda g: solve_batch(g, spec, **cfg, **kw)
+    )(jnp.asarray(boards, jnp.int32))
+    return (
+        np.asarray(res.status),
+        np.asarray(res.grid),
+        np.asarray(res.solved),
+    )
+
+
+# --- parity: compacted vs legacy, packed vs unpacked, across sizes --------
+# Slices keep tier-1 runtime bounded; the 16×16 deep slice deliberately
+# includes a board that hits the iteration cap (statuses must still agree
+# bit-for-bit, RUNNING included — the straggler is stepped in every
+# iteration of BOTH arms, so its partial grid at the cap is identical).
+_PARITY_CASES = [
+    ("corpus_9x9_adversarial_128.npz", 9, None, 65536),
+    ("corpus_16x16_deep_anneal_64.npz", 16, 6, 20000),
+    ("corpus_25x25_deep_anneal_32.npz", 25, 4, 20000),
+]
+
+
+@pytest.mark.parametrize("name,size,n,max_iters", _PARITY_CASES)
+def test_compacted_matches_legacy(name, size, n, max_iters):
+    boards = _corpus(name, n)
+    st_new, g_new, ok_new = _solve(boards, size, max_iters)
+    st_old, g_old, _ = _solve(boards, size, max_iters, legacy_loop=True)
+    np.testing.assert_array_equal(st_new, st_old)
+    np.testing.assert_array_equal(g_new, g_old)
+    assert ok_new.sum() >= len(boards) - 1  # the corpus actually solves
+
+
+@pytest.mark.parametrize(
+    "name,size,n,max_iters",
+    [c for c in _PARITY_CASES if c[1] <= 16],  # packed needs N ≤ 16
+)
+def test_packed_matches_unpacked(name, size, n, max_iters):
+    boards = _corpus(name, n)
+    st_p, g_p, _ = _solve(boards, size, max_iters, packed=True)
+    st_u, g_u, _ = _solve(boards, size, max_iters, packed=False)
+    np.testing.assert_array_equal(st_p, st_u)
+    np.testing.assert_array_equal(g_p, g_u)
+
+
+def test_packed_analyze_bit_identical_including_degenerate():
+    """analyze(packed=True) output equality on clean, unsatisfiable,
+    out-of-range, and negative-value boards — every Analysis field."""
+    boards = _corpus("corpus_9x9_hard_64.npz")
+    bad = np.zeros((4, 9, 9), np.int32)
+    bad[0, 0, 0] = bad[0, 0, 1] = 7
+    bad[1, 0, 0] = 10
+    bad[2, 4, 4] = -3
+    for src in (boards, bad):
+        for pairs in (False, True):
+            a = analyze(
+                jnp.asarray(src), SPEC_9, locked=True, naked_pairs=pairs,
+                packed=False,
+            )
+            b = analyze(
+                jnp.asarray(src), SPEC_9, locked=True, naked_pairs=pairs,
+                packed=True,
+            )
+            for f in ("cand", "assign", "contradiction", "solved"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                    err_msg=f"pairs={pairs} field={f}",
+                )
+
+
+def test_packed_rejected_for_25x25():
+    spec25 = spec_for_size(25)
+    with pytest.raises(ValueError, match="packed bitplane"):
+        analyze(jnp.zeros((1, 25, 25), jnp.int32), spec25, packed=True)
+    assert packed_default(25) is False  # and the default never trips it
+
+
+def test_periodic_descent_check_same_results():
+    """compact_every > 1 only delays ladder descent — statuses and grids
+    are unchanged (the K knob is a pure performance schedule)."""
+    boards = _corpus("corpus_9x9_hard_64.npz")
+    st1, g1, _ = _solve(boards, 9, 4096)
+    st4, g4, _ = _solve(boards, 9, 4096, compact_every=4)
+    np.testing.assert_array_equal(st1, st4)
+    np.testing.assert_array_equal(g1, g4)
+
+
+def test_solver_preset_resolution():
+    assert resolve_solver_overrides(None) == {}
+    assert resolve_solver_overrides("default") == {}
+    assert resolve_solver_overrides("legacy") == {"legacy_loop": True}
+    assert resolve_solver_overrides({"packed": False}) == {"packed": False}
+    with pytest.raises(ValueError, match="unknown solver config"):
+        resolve_solver_overrides("bogus")
+    # typos and engine-owned knobs fail at configuration time, not as an
+    # opaque TypeError inside the first jit trace
+    with pytest.raises(ValueError, match="compact_flor"):
+        resolve_solver_overrides({"compact_flor": 8})
+    with pytest.raises(ValueError, match="waves"):
+        resolve_solver_overrides({"waves": 2})
+
+
+# --- counter proofs -------------------------------------------------------
+
+def test_straggler_stops_paying_batch_wide_sweeps():
+    """One hard board among 63 easy ones: with the compacted loop the
+    finished boards stop iterating — idle lanes per tail iteration stay
+    under the ladder floor, vs ~B for the legacy full-batch tail."""
+    easy = generate_batch(63, 30, seed=20260803)
+    hard = _corpus("corpus_9x9_hard_64.npz", 1)
+    batch = jnp.asarray(np.concatenate([easy, hard], axis=0))
+    cfg = serving_config(9)
+
+    out = {}
+    for name, kw in (("default", {}), ("legacy", {"legacy_loop": True})):
+        res, st = jax.jit(
+            lambda g, kw=kw: solve_batch(
+                g, SPEC_9, return_stats=True, **cfg, **kw
+            )
+        )(batch)
+        assert bool(np.asarray(res.solved).all())
+        out[name] = {
+            "iters": int(res.iters),
+            "lane": int(st.lane_steps),
+            "idle": int(st.idle_lane_steps),
+        }
+    floor = compaction_config(9)["floor"]
+    idle_per_iter = out["default"]["idle"] / out["default"]["iters"]
+    legacy_idle_per_iter = out["legacy"]["idle"] / out["legacy"]["iters"]
+    assert idle_per_iter < floor, (idle_per_iter, out)
+    # the legacy loop pays most of the batch as idle lanes through the tail
+    assert legacy_idle_per_iter > 40, (legacy_idle_per_iter, out)
+    assert out["default"]["idle"] < 0.35 * out["legacy"]["idle"], out
+
+
+def test_pallas_idle_counters():
+    """The kernel's block-granular early exit is its compaction analog:
+    LoopStats ride the meta plane, and a block of easy boards exits
+    without paying the other block's straggler tail."""
+    from sudoku_solver_distributed_tpu.ops.pallas_solver import (
+        solve_batch_pallas,
+    )
+
+    easy = generate_batch(4, 30, seed=5)
+    hard = _corpus("corpus_9x9_hard_64.npz", 4)
+    batch = jnp.asarray(np.concatenate([easy, hard], axis=0), jnp.int32)
+    res, st = solve_batch_pallas(
+        batch, SPEC_9, block=4, interpret=True, return_stats=True
+    )
+    assert bool(np.asarray(res.solved).all())
+    lane, idle = int(st.lane_steps), int(st.idle_lane_steps)
+    assert lane > 0 and 0 <= idle < lane
+    # blocked run must sweep fewer lanes than a single lockstep batch
+    # would: the easy block exits early
+    single, st_one = solve_batch_pallas(
+        batch, SPEC_9, block=8, interpret=True, return_stats=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.grid), np.asarray(single.grid)
+    )
+    assert lane < int(st_one.lane_steps)
+
+
+# --- golden-counter perf regression guard (ISSUE 7 satellite) -------------
+
+def test_golden_counters_deep_union():
+    """Iteration/guess/sweep counts on the seeded deep corpus, pinned to
+    within +5% of the committed goldens. These counters are platform- and
+    schedule-independent (they follow only the search trajectory the
+    serving config fixes), so a regression here is a real algorithmic
+    regression, not measurement noise. Improvements are allowed — commit
+    new goldens via tests/tools/regen_golden_counters.py when intended."""
+    golden = json.load(
+        open(os.path.join(REPO, "tests", "golden_counters.json"))
+    )
+    boards = _corpus(golden["corpus"])
+    assert boards.shape[0] == golden["boards"]
+    cfg = {**serving_config(9), "max_iters": golden["config"]["max_iters"]}
+    res, st = jax.jit(
+        lambda g: solve_batch(g, SPEC_9, return_stats=True, **cfg)
+    )(jnp.asarray(boards))
+    assert int(np.asarray(res.solved).sum()) == golden["solved"]
+    measured = {
+        "iters": int(res.iters),
+        "guesses": int(np.asarray(res.guesses).sum()),
+        "validations": int(np.asarray(res.validations).sum()),
+    }
+    for key, value in measured.items():
+        assert value <= golden[key] * 1.05, (
+            f"{key} regressed: {value} vs golden {golden[key]} "
+            f"(+{100 * (value / golden[key] - 1):.1f}%; >5% fails — see "
+            f"tests/golden_counters.json)"
+        )
+    idle_fraction = int(st.idle_lane_steps) / max(1, int(st.lane_steps))
+    assert idle_fraction <= golden["idle_fraction_max"], (
+        f"compaction effectiveness regressed: idle fraction "
+        f"{idle_fraction:.3f} > {golden['idle_fraction_max']}"
+    )
+
+
+# --- engine plumbing ------------------------------------------------------
+
+def test_engine_solver_config_plumbing():
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+
+    boards = generate_batch(8, 50, seed=3, unique=True)
+    eng = SolverEngine(buckets=(8,), coalesce=False)
+    leg = SolverEngine(buckets=(8,), coalesce=False, solver_config="legacy")
+    s1, ok1, _ = eng.solve_batch_np(np.asarray(boards))
+    s2, ok2, _ = leg.solve_batch_np(np.asarray(boards))
+    assert ok1.all() and ok2.all()
+    np.testing.assert_array_equal(s1, s2)
+
+    info = eng.warm_info()["solver_loop"]
+    assert info["legacy"] is False and info["packed"] is True
+    assert info["ladder"][0] == 8
+    linfo = leg.warm_info()["solver_loop"]
+    assert linfo["legacy"] is True and linfo["packed"] is False
+    assert (linfo["compact_div"], linfo["compact_floor"]) == (4, 64)
+    # the AOT artifact key must see the loop shape (a legacy engine may
+    # never load a default-loop executable)
+    assert eng._program_config() != leg._program_config()
+
+    with pytest.raises(ValueError, match="unknown solver config"):
+        SolverEngine(buckets=(8,), solver_config="nope")
+    with pytest.raises(ValueError, match="xla hot loop"):
+        SolverEngine(
+            buckets=(8,), backend="pallas", solver_config="legacy"
+        )

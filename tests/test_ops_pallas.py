@@ -88,6 +88,61 @@ def test_pallas_16x16_matches_xla():
     np.testing.assert_array_equal(np.asarray(res.grid), np.asarray(ref.grid))
 
 
+def test_pallas_fused_validate_parity():
+    """PR 7 fused propagate+validate: the kernel's in-loop solved/dup
+    verdicts, the XLA analyze's fused verdicts, and the standalone
+    validate kernels (now on the same once/twice unit reductions) must
+    agree — on solved boards, near-miss corruptions, duplicates, and
+    out-of-range values."""
+    import jax
+
+    from sudoku_solver_distributed_tpu.models import oracle_solve
+    from sudoku_solver_distributed_tpu.ops import check_boards
+    from sudoku_solver_distributed_tpu.ops.propagate import analyze
+
+    solved = np.asarray(
+        oracle_solve(generate_batch(1, 40, seed=37)[0].tolist()), np.int32
+    )
+    batch = np.stack([solved] * 4)
+    batch[1, 0, 0] = batch[1][0][1]      # row duplicate
+    batch[2, 0, 0] = 17                  # out of range
+    batch[3, 8, 8] = 0                   # one hole — not solved, not contra
+    dev = jnp.asarray(batch)
+
+    valid = np.asarray(check_boards(dev, SPEC_9))
+    a = analyze(dev, SPEC_9)
+    np.testing.assert_array_equal(valid, np.asarray(a.solved))
+    assert valid.tolist() == [True, False, False, False]
+    # contradiction only where a rule is violated (the hole is fine)
+    assert np.asarray(a.contradiction).tolist() == [False, True, True, False]
+
+    # shift-aliasing guard: a cell holding old_value+32 must NOT pass the
+    # bitmask checker on any backend (1 << 35 aliases 1 << 3 where the
+    # shift amount wraps mod 32; _unit_masks masks out-of-range first)
+    aliased = solved.copy()
+    aliased[aliased == 4] = 36
+    assert not bool(
+        np.asarray(check_boards(jnp.asarray(aliased[None]), SPEC_9))[0]
+    )
+
+    # the pallas kernel's status lanes carry the same verdicts
+    res = _pallas(batch, block=4)
+    st = np.asarray(res.status)
+    assert st[0] == SOLVED          # already-solved passes through
+    assert st[1] == UNSAT and st[2] == UNSAT
+    assert st[3] == SOLVED          # one hole is one naked single
+    # and every grid the kernel claims SOLVED passes the fused checker
+    assert bool(np.asarray(check_boards(jnp.asarray(res.grid), SPEC_9))[
+        np.asarray(res.solved)
+    ].all())
+    # XLA path agrees bit-for-bit
+    ref = jax.jit(lambda g: solve_batch(g, SPEC_9))(dev)
+    np.testing.assert_array_equal(st, np.asarray(ref.status))
+    np.testing.assert_array_equal(
+        np.asarray(res.grid), np.asarray(ref.grid)
+    )
+
+
 def test_pallas_staged_depth_overflow_retry():
     """Tuple max_depth: stage-0 overflow reruns at the deeper stage behind a
     lax.cond, matching the flat-depth run exactly (ops.solver's staging

@@ -1,0 +1,48 @@
+"""Regenerate tests/golden_counters.json (run ONLY after an intended
+search-order change — a silent regression is exactly what the golden
+guard exists to catch): python tests/tools/regen_golden_counters.py"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from sudoku_solver_distributed_tpu.ops import spec_for_size, solve_batch  # noqa: E402
+from sudoku_solver_distributed_tpu.ops.config import serving_config  # noqa: E402
+
+OUT = os.path.join(REPO, "tests", "golden_counters.json")
+CORPUS = "corpus_9x9_deep_union.npz"
+
+boards = np.load(os.path.join(REPO, "benchmarks", CORPUS))["boards"]
+cfg = {**serving_config(9), "max_iters": 65536}
+res, st = jax.block_until_ready(
+    jax.jit(
+        lambda g: solve_batch(g, spec_for_size(9), return_stats=True, **cfg)
+    )(jnp.asarray(boards))
+)
+old = json.load(open(OUT))
+record = {
+    "_comment": old["_comment"],
+    "config": {"size": 9, **{k: v for k, v in cfg.items()}},
+    "corpus": CORPUS,
+    "boards": int(boards.shape[0]),
+    "solved": int(np.asarray(res.solved).sum()),
+    "iters": int(res.iters),
+    "guesses": int(np.asarray(res.guesses).sum()),
+    "validations": int(np.asarray(res.validations).sum()),
+    "idle_fraction_max": old["idle_fraction_max"],
+}
+with open(OUT, "w") as f:
+    json.dump(record, f, indent=2)
+    f.write("\n")
+print(json.dumps(record, indent=2))
+print(
+    "idle_fraction now:",
+    round(int(st.idle_lane_steps) / int(st.lane_steps), 4),
+)
