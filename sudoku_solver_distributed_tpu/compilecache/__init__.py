@@ -19,6 +19,7 @@ Two layers, both rooted under one operator-chosen directory (CLI
 from .store import (
     AotStore,
     backend_fingerprint,
+    device_fingerprint,
     enable_persistent_cache,
     program_key,
 )
@@ -26,6 +27,7 @@ from .store import (
 __all__ = [
     "AotStore",
     "backend_fingerprint",
+    "device_fingerprint",
     "enable_persistent_cache",
     "program_key",
 ]

@@ -89,6 +89,17 @@ def backend_fingerprint() -> str:
     )
 
 
+def device_fingerprint(devices) -> str:
+    """Identity of a CONCRETE device assignment (mesh serving, ISSUE 8):
+    a serialized executable for a sharded program bakes which physical
+    device holds which shard, so the exec tier is only trusted on the
+    exact ordered device list that compiled it. The portable StableHLO
+    tier deliberately ignores this — any assignment with the same device
+    COUNT can recompile it (that is the cross-topology tier a pod node
+    cold-starts from)."""
+    return ",".join(f"{d.platform}:{d.id}" for d in devices)
+
+
 def program_key(name: str, spec, bucket: int, config: Dict[str, Any]) -> str:
     """Stable artifact key for one compiled program: the program name,
     board geometry, static batch width, and every solver knob baked into
@@ -143,7 +154,7 @@ class AotStore:
             "errors": self.errors,
         }
 
-    def load(self, key: str, fingerprint: str):
+    def load(self, key: str, fingerprint: str, device_fp: str = None):
         """Load the artifact stored under ``key``.
 
         Returns ``(callable, kind)`` or ``(None, None)``. Two tiers per
@@ -153,11 +164,18 @@ class AotStore:
             (``jax.experimental.serialize_executable``): zero compile on
             load. PJRT backends differ in support — the CPU runtime in
             this jax generation deserializes to dangling symbol refs —
-            so a failure here just falls to the next tier.
+            so a failure here just falls to the next tier. For sharded
+            programs (mesh serving, ISSUE 8) this tier additionally
+            requires the stored concrete device assignment
+            (``device_fingerprint``) to match ``device_fp`` exactly: a
+            serialized executable bakes which device holds which shard.
           * ``"ir"`` — the portable StableHLO module (``jax.export``):
             skips the (expensive) Python re-trace; its compile is a
             persistent-XLA-cache disk hit whenever this backend compiled
-            the program before.
+            the program before. Cross-topology on purpose: any device
+            assignment with the same count can take this tier, so a pod
+            node with a different mesh layout still cold-starts off the
+            store instead of re-tracing.
 
         Misses/mismatches return ``(None, None)`` (counted); a file that
         fails BOTH tiers is deleted so it cannot fail every later start.
@@ -192,7 +210,17 @@ class AotStore:
             )
             self.errors += 1
             return None, None
-        if record.get("payload") is not None:
+        assignment_mismatch = record.get("device_fp") != device_fp
+        if assignment_mismatch:
+            # a different concrete device assignment compiled this: the
+            # exec tier would load a program whose shard placement does
+            # not exist here — only the portable IR tier applies
+            logger.info(
+                "AOT artifact %s: device assignment differs (%s != %s) — "
+                "exec tier skipped, trying the StableHLO tier",
+                key, record.get("device_fp"), device_fp,
+            )
+        elif record.get("payload") is not None:
             try:
                 from jax.experimental import serialize_executable
 
@@ -216,10 +244,18 @@ class AotStore:
                 return jax.jit(exported.call), "ir"
             except Exception:  # noqa: BLE001
                 logger.exception(
-                    "AOT artifact %s: StableHLO tier failed too — "
-                    "deleting", key
+                    "AOT artifact %s: StableHLO tier failed too — %s",
+                    key,
+                    "keeping (assignment mismatch: the exec tier may "
+                    "still serve its own topology)"
+                    if assignment_mismatch
+                    else "deleting",
                 )
         self.errors += 1
+        if assignment_mismatch:
+            # not corruption — the exec tier belongs to another topology
+            # and this file may still serve it; keep the artifact
+            return None, None
         try:
             os.remove(path)
         except OSError:
@@ -233,11 +269,16 @@ class AotStore:
         fingerprint: str,
         meta: Optional[Dict[str, Any]] = None,
         stablehlo: Optional[bytes] = None,
+        device_fp: Optional[str] = None,
     ) -> bool:
         """Serialize ``compiled`` (and optionally its portable StableHLO
         twin from ``jax.export``) under ``key``. Atomic (tmp + rename, so
         a crashed writer can't leave a half-artifact that poisons every
-        later cold start). Best-effort: False on failure, never raises."""
+        later cold start). ``device_fp`` records the concrete device
+        assignment a sharded program was compiled against (the exec tier's
+        extra gate; None for single-device programs — back-compatible with
+        every pre-mesh artifact). Best-effort: False on failure, never
+        raises."""
         try:
             payload = in_tree = out_tree = None
             try:
@@ -258,6 +299,7 @@ class AotStore:
             record = {
                 "format": _FORMAT,
                 "fingerprint": fingerprint,
+                "device_fp": device_fp,
                 "meta": meta or {},
                 "payload": payload,
                 "in_tree": in_tree,

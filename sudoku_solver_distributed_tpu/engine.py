@@ -65,9 +65,29 @@ class SolverEngine:
         Unspecified → the measured staged depth from ops.SERVING_CONFIG
         (shallow fast path + full-depth retry); explicit None → the flat
         per-spec safe default.
+      mesh: the mesh-parallel serving plane (ISSUE 8). "auto" — the CLI
+        serving default — builds a 1-D ``data`` mesh over every local
+        device when more than ``ops.config.MESH_SERVING["auto_min_devices"]
+        - 1`` are present (and an explicit ``sharding=`` was not given —
+        a pinned placement wins over auto), and every bucket program becomes a
+        shard_map-over-``data`` collective (parallel/shard.
+        make_packed_serving_program): one coalesced batch is split across
+        all chips instead of leaving N−1 idle. Pass an explicit
+        ``jax.sharding.Mesh`` (1-D, axis ``"data"``) to pin the device
+        set, or None (library default) for the single-device programs.
+        Bucket widths round UP to mesh-divisible multiples (recorded in
+        ``mesh_info()``); results stay bit-identical to single-device —
+        the per-board search trajectory is schedule-independent
+        (tests/test_mesh_serving.py parity). xla backend only.
+      bucket_multiple: round bucket widths up to multiples of this instead
+        of the mesh size (multi-host serving: the CLI passes the GLOBAL
+        device count so leader fan-out batches divide the pod-wide mesh
+        while each host's own programs run on its local mesh).
       sharding: optional jax.sharding.Sharding for the batch axis — supply a
         NamedSharding over a device mesh to fan one bucket out across chips
-        (the TPU-native analog of the reference's peer task farm).
+        (the TPU-native analog of the reference's peer task farm). The
+        ``mesh=`` plane supersedes this (and sets it internally); the raw
+        parameter remains for placement-only use without sharded programs.
       frontier_mesh: optional jax.sharding.Mesh — when set, single-board
         ``solve_one`` requests are routed through the sharded search-frontier
         race (parallel/frontier.py): the board's DFS subtrees are raced
@@ -153,6 +173,8 @@ class SolverEngine:
         *,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         max_depth=_AUTO,
+        mesh=None,
+        bucket_multiple: Optional[int] = None,
         sharding: Optional[jax.sharding.Sharding] = None,
         frontier_mesh: Optional[jax.sharding.Mesh] = None,
         frontier_states_per_device: int = 64,
@@ -178,17 +200,89 @@ class SolverEngine:
     ):
         if backend not in ("xla", "pallas"):
             raise ValueError(f"unknown engine backend {backend!r}")
-        if backend == "pallas" and sharding is not None:
+        if backend == "pallas" and (sharding is not None or mesh is not None):
             # pallas_call has no GSPMD partitioning rule: the sharded bucket
             # would either fail to compile or silently replicate onto every
             # chip. Mesh fan-out for the pallas kernel needs a shard_map
             # wrapper (ROADMAP); refuse rather than mislead.
             raise ValueError(
-                "backend='pallas' does not compose with sharding= — use the "
-                "xla backend for mesh-sharded buckets"
+                "backend='pallas' does not compose with mesh=/sharding= — "
+                "use the xla backend for mesh-sharded buckets"
             )
         self.spec = spec
-        self.buckets = tuple(sorted(set(buckets)))
+        # Mesh-parallel serving plane (ISSUE 8): resolve the batch mesh
+        # before the bucket ladder — widths round to mesh-divisible
+        # multiples so every coalesced batch splits over all devices.
+        if mesh == "auto":
+            from .ops.config import mesh_serving_config
+
+            # LOCAL devices only: jax.devices() spans every host once
+            # jax.distributed is initialized, and a pod-global program
+            # dispatched by one host outside the lockstep serving loop
+            # would hang on followers that never enter the collective
+            # (multi-host fan-out goes through engine.mesh_runner, wired
+            # explicitly by the CLI). An explicit sharding= wins over
+            # auto — the caller pinned a placement; keep the raw
+            # sharding contract instead of silently overwriting it.
+            local = jax.local_devices()
+            mesh = None
+            if (
+                sharding is None
+                and len(local) >= mesh_serving_config()["auto_min_devices"]
+            ):
+                from .parallel.mesh import default_mesh
+
+                mesh = default_mesh(local)
+        elif mesh is not None:
+            if sharding is not None:
+                raise ValueError(
+                    "mesh= and sharding= are mutually exclusive — the mesh "
+                    "plane derives its own data-axis sharding"
+                )
+            if "data" not in getattr(mesh, "axis_names", ()):
+                raise ValueError(
+                    "mesh= must be a 1-D jax.sharding.Mesh with a 'data' "
+                    f"axis, got axes {getattr(mesh, 'axis_names', None)!r}"
+                )
+        self.mesh = mesh
+        if mesh is not None:
+            from .parallel.mesh import data_sharding
+
+            sharding = data_sharding(mesh)
+        # Leader fan-out hook (multi-host mesh serving): when set (a
+        # callable (padded_boards, iters) -> host packed rows), bucket
+        # dispatches route through the SPMD serving loop so every pod
+        # host's devices enter the collective (parallel/serving_loop.py
+        # solve_padded); the CLI wires it on the leader. None: local
+        # dispatch through this engine's own programs.
+        self.mesh_runner = None
+        buckets = tuple(sorted(set(buckets)))
+        self.requested_buckets = buckets
+        if mesh is not None or bucket_multiple:
+            from .ops.config import mesh_serving_config
+
+            fill = mesh_serving_config()["min_per_device_fill"]
+            mult = int(
+                bucket_multiple
+                or (mesh.devices.size * fill if mesh is not None else 1)
+            )
+            buckets = tuple(sorted({-(-b // mult) * mult for b in buckets}))
+            if buckets != self.requested_buckets:
+                logger.info(
+                    "mesh serving: bucket ladder %s rounded to "
+                    "mesh-divisible %s (multiple %d)",
+                    self.requested_buckets, buckets, mult,
+                )
+        self.buckets = buckets
+        # mesh dispatch counters (under _lock): the batch-split evidence
+        # mesh_info()/ /metrics report — how many sharded dispatches ran
+        # and how the LAST batch actually landed on the mesh (read from
+        # the output array's sharding metadata, parallel/shard.
+        # split_evidence)
+        self.mesh_dispatches = 0
+        self.mesh_runner_dispatches = 0
+        self._mesh_last_split: dict = {}
+        self._mesh_min_devices: Optional[int] = None
         # Unspecified knobs resolve from ops.SERVING_CONFIG — ONE definition
         # site shared with bench.py and __graft_entry__ (VERDICT r2 weak #1),
         # so the benched configuration IS the served one. Custom board sizes
@@ -407,10 +501,13 @@ class SolverEngine:
         self._programs: set = set()
         # Persistent compile plane (compilecache/): implicit XLA disk
         # cache + explicit AOT executable store. AOT executables install
-        # into _aot_execs[bucket] and take priority over the jit path;
-        # sharded engines skip the store (a serialized executable bakes
-        # its device assignment — the fingerprint covers count, not an
-        # arbitrary mesh layout).
+        # into _aot_execs[bucket] and take priority over the jit path.
+        # Mesh engines use the store too (the PR 4 gap, closed in ISSUE
+        # 8): the serialized-executable tier is additionally keyed by the
+        # concrete device assignment (compilecache.device_fingerprint)
+        # and the portable StableHLO tier is the cross-topology fallback;
+        # only a RAW sharding= without the mesh plane still skips it (no
+        # mesh to derive sharded avals from).
         self.compile_cache_dir = compile_cache_dir
         self._aot_store = None
         self._aot_execs: dict = {}
@@ -419,7 +516,9 @@ class SolverEngine:
             from .compilecache import AotStore, enable_persistent_cache
 
             enable_persistent_cache(os.path.join(compile_cache_dir, "xla"))
-            if aot_artifacts and backend == "xla" and sharding is None:
+            if aot_artifacts and backend == "xla" and (
+                sharding is None or mesh is not None
+            ):
                 self._aot_store = AotStore(
                     os.path.join(compile_cache_dir, "aot")
                 )
@@ -490,6 +589,36 @@ class SolverEngine:
             self._solve_quick = self._counted(
                 "quick",
                 jax.jit(lambda grid: _run(grid, frontier_escalate_iters)),
+            )
+        elif self.mesh is not None:
+            # Mesh-parallel bucket programs (ISSUE 8): the SAME packed-row
+            # contract and traced iteration budget, shard_mapped over the
+            # mesh's data axis so one bucket batch splits across every
+            # device (parallel/shard.make_packed_serving_program — one
+            # memoized implementation shared with the multi-host serving
+            # loop's global-mesh fan-out). waves follows the GLOBAL bucket
+            # width (always >1 here — buckets are mesh-rounded), matching
+            # what the single-device program would trace for the same
+            # width, so work counters stay parity-comparable.
+            from .parallel.shard import make_packed_serving_program
+
+            self._program = make_packed_serving_program(
+                self.mesh,
+                self.spec,
+                max_depth=self.max_depth,
+                locked_candidates=self.locked_candidates,
+                waves=self.waves,
+                naked_pairs=self.naked_pairs,
+                solver_overrides=tuple(
+                    sorted(self.solver_overrides.items())
+                ),
+            )
+            self._solve = lambda grid: self._exec(grid, self.max_iters)
+            self._solve_deep = lambda grid: self._exec(
+                grid, self.max_iters * self.deep_retry_factor
+            )
+            self._solve_quick = lambda grid: self._exec(
+                grid, self.frontier_escalate_iters
             )
         else:
             # ONE parameterized program per bucket width: the lockstep
@@ -635,6 +764,11 @@ class SolverEngine:
             "fully_warmed": self.fully_warmed,
             "warm": self.warm_info(),
         }
+        mesh = self.mesh_info()
+        if mesh is not None:
+            # the mesh-serving plane (ISSUE 8): topology + batch-split
+            # counter evidence, the /metrics "engine.mesh" block
+            out["mesh"] = mesh
         if self.supervisor is not None:
             # the one-word summary; the full state machine lives in the
             # /metrics top-level "health" block (supervisor.snapshot())
@@ -802,6 +936,17 @@ class SolverEngine:
                 boards[0], (bucket - n, *boards.shape[1:])
             )
             boards = np.concatenate([boards, pad], axis=0)
+        if self.mesh_runner is not None:
+            # multi-host leader fan-out (parallel/serving_loop.py): the
+            # padded bucket batch rides the SPMD loop's broadcast so every
+            # pod host's devices enter the collective; returns host rows
+            # (the loop's collective already synced), which _finalize_padded
+            # passes through unchanged. Local profiling hooks don't apply —
+            # the work runs inside the loop's round on every host.
+            packed = self.mesh_runner(boards, int(self.max_iters))
+            with self._lock:
+                self.mesh_runner_dispatches += 1
+            return packed, boards, n
         if (
             self._device_trace_budget > 0
             and self.device_trace_dir is not None
@@ -839,6 +984,22 @@ class SolverEngine:
                 self._profile_mutex.release()
         else:
             packed = self._solve(self._device_batch(boards))
+        if self.mesh is not None:
+            # batch-split evidence (sharding METADATA only — no transfer,
+            # no sync on the in-flight call): how the dispatched batch
+            # landed on the mesh, surfaced at mesh_info()/ /metrics
+            from .parallel.shard import split_evidence
+
+            split = split_evidence(packed)
+            with self._lock:
+                self.mesh_dispatches += 1
+                self._mesh_last_split = split
+                ndev = split.get("devices", 1)
+                if (
+                    self._mesh_min_devices is None
+                    or ndev < self._mesh_min_devices
+                ):
+                    self._mesh_min_devices = ndev
         return packed, boards, n
 
     def _finalize_padded(
@@ -909,11 +1070,21 @@ class SolverEngine:
                     ],
                     axis=0,
                 )
-            deep = np.asarray(
-                jax.block_until_ready(
-                    self._solve_deep(self._device_batch(sub))
+            if self.mesh_runner is not None:
+                # the deep retry is a collective too: it must ride the
+                # loop like the first pass, or the leader would enter a
+                # global program the followers never join
+                deep = np.asarray(
+                    self.mesh_runner(
+                        sub, int(self.max_iters * self.deep_retry_factor)
+                    )
                 )
-            )
+            else:
+                deep = np.asarray(
+                    jax.block_until_ready(
+                        self._solve_deep(self._device_batch(sub))
+                    )
+                )
             first = packed[capped].copy()
             packed[capped] = deep[: len(capped)]
             packed[capped, C + 2] += first[:, C + 2]
@@ -1140,6 +1311,16 @@ class SolverEngine:
             cfg["solver_loop"] = dict(
                 sorted(self.solver_loop_info().items())
             )
+        if self.mesh is not None:
+            # the mesh SHAPE and sharding spec are trace constants of the
+            # shard_map program: a 4-way split is a different program than
+            # an 8-way one, and a single-device artifact must never load
+            # into a sharded engine (ISSUE 8 — the PR 4 gap)
+            cfg["mesh"] = {
+                "axis": "data",
+                "devices": int(self.mesh.devices.size),
+                "spec": "P('data')",
+            }
         return cfg
 
     def _aot_load_or_compile(self, b: int):
@@ -1150,11 +1331,24 @@ class SolverEngine:
         Compile path: explicit lower().compile() (a persistent-XLA-cache
         hit when the HLO was ever compiled here), saved back to the
         store for the next cold start."""
-        from .compilecache import backend_fingerprint, program_key
+        from .compilecache import (
+            backend_fingerprint,
+            device_fingerprint,
+            program_key,
+        )
 
         key = program_key("solve", self.spec, b, self._program_config())
         fp = backend_fingerprint()
-        exe, kind = self._aot_store.load(key, fp)
+        # the exec tier's extra gate for sharded programs: a serialized
+        # executable bakes which device holds which shard, so it is only
+        # trusted on the exact ordered assignment that compiled it; the
+        # StableHLO tier stays assignment-portable (compilecache/store.py)
+        dev_fp = (
+            device_fingerprint(self.mesh.devices.flat)
+            if self.mesh is not None
+            else None
+        )
+        exe, kind = self._aot_store.load(key, fp, device_fp=dev_fp)
         if exe is not None:
             if self._verify_aot(exe, b):
                 return exe, f"aot:{kind}"
@@ -1167,10 +1361,16 @@ class SolverEngine:
             self._aot_store.invalidate(key)
         try:
             N = self.spec.size
-            avals = (
-                jax.ShapeDtypeStruct((b, N, N), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32),
-            )
+            # sharded programs lower against data-sharded input avals so
+            # the compiled executable carries the mesh partitioning (and
+            # the StableHLO export records it for the portable tier)
+            if self.sharding is not None:
+                board_aval = jax.ShapeDtypeStruct(
+                    (b, N, N), jnp.int32, sharding=self.sharding
+                )
+            else:
+                board_aval = jax.ShapeDtypeStruct((b, N, N), jnp.int32)
+            avals = (board_aval, jax.ShapeDtypeStruct((), jnp.int32))
             compiled = self._program.lower(*avals).compile()
             stablehlo = None
             try:
@@ -1200,6 +1400,7 @@ class SolverEngine:
                     },
                 },
                 stablehlo=stablehlo,
+                device_fp=dev_fp,
             )
             if saved:
                 # bake-and-check: load the artifact back and round-trip
@@ -1207,7 +1408,7 @@ class SolverEngine:
                 # serve, and the check compiles the IR tier's module into
                 # the persistent XLA cache so the next cold start's
                 # aot:ir load is a disk hit instead of a fresh compile
-                exe2, _kind2 = self._aot_store.load(key, fp)
+                exe2, _kind2 = self._aot_store.load(key, fp, device_fp=dev_fp)
                 if exe2 is None or not self._verify_aot(exe2, b):
                     logger.warning(
                         "just-saved AOT artifact for width %d failed its "
@@ -1229,10 +1430,13 @@ class SolverEngine:
         N = self.spec.size
         C = self.spec.cells
         try:
+            # _device_batch, not a bare asarray: a sharded executable is
+            # strict about its input placement — the probe batch must land
+            # on the mesh exactly as serving batches do
             packed = np.asarray(
                 jax.block_until_ready(
                     exe(
-                        jnp.asarray(np.zeros((b, N, N), np.int32)),
+                        self._device_batch(np.zeros((b, N, N), np.int32)),
                         jnp.int32(self.max_iters),
                     )
                 )
@@ -1353,6 +1557,35 @@ class SolverEngine:
             ),
         }
 
+    def mesh_info(self) -> Optional[dict]:
+        """The ``engine.mesh`` block of ``GET /metrics`` (ISSUE 8):
+        resolved mesh topology, the bucket-ladder rounding it forced,
+        per-device fill per bucket, and the batch-split counter evidence
+        (device count + rows-per-device of the last dispatch, read from
+        output sharding metadata). None when the engine has no mesh."""
+        if self.mesh is None:
+            return None
+        n_dev = int(self.mesh.devices.size)
+        with self._lock:
+            last_split = dict(self._mesh_last_split)
+            dispatches = self.mesh_dispatches
+            runner_dispatches = self.mesh_runner_dispatches
+            min_devices = self._mesh_min_devices
+        return {
+            "devices": n_dev,
+            "axis": "data",
+            "device_kinds": sorted(
+                {d.device_kind for d in self.mesh.devices.flat}
+            ),
+            "buckets_requested": list(self.requested_buckets),
+            "buckets": list(self.buckets),
+            "per_device_fill": {str(b): b // n_dev for b in self.buckets},
+            "dispatches": dispatches,
+            "runner_dispatches": runner_dispatches,
+            "last_split": last_split,
+            "min_devices_seen": min_devices,
+        }
+
     def warm_info(self) -> dict:
         """Per-bucket warm state (the /metrics ``engine.warm`` block):
         which widths are compiled and from what source (``aot`` /
@@ -1384,6 +1617,11 @@ class SolverEngine:
                 }
         if self._aot_store is not None:
             out["aot"] = self._aot_store.stats()
+        # outside _warm_lock: mesh_info takes the engine stats lock and
+        # the two must never nest (analysis/locks.py ordering discipline)
+        mesh = self.mesh_info()
+        if mesh is not None:
+            out["mesh"] = mesh
         return out
 
     def solve_batch_np(self, boards: np.ndarray) -> Tuple[np.ndarray, np.ndarray, dict]:
@@ -1438,8 +1676,13 @@ class SolverEngine:
         bucket = self._bucket_for(1)
         boards = arr[None]
         if bucket > 1:
+            # pad with COPIES of the probe board, not empty boards: an
+            # empty board's full DFS can dwarf the probe's own work, and
+            # on a mesh engine the smallest bucket is the device count —
+            # every probe would pay n_dev-1 empty-board solves (same
+            # rationale as _dispatch_padded's real-row padding)
             boards = np.concatenate(
-                [boards, np.zeros((bucket - 1, *arr.shape), arr.dtype)]
+                [boards, np.broadcast_to(arr, (bucket - 1, *arr.shape))]
             )
         # explicit sync at the probe's documented fetch point (JAX101)
         packed = np.asarray(
@@ -1581,6 +1824,14 @@ class SolverEngine:
         from .utils.checkpoint import solve_batch_resumable
 
         boards = np.asarray(boards, np.int32)
+        # the mesh plane's data sharding only places mesh-divisible
+        # batches; resumable solves take arbitrary B, so fall back to
+        # default placement when the batch doesn't divide (explicit
+        # sharding= callers keep the old contract: they sized their batch)
+        sharding = self.sharding
+        if self.mesh is not None and sharding is not None:
+            if boards.shape[0] % int(self.mesh.devices.size):
+                sharding = None
         res = solve_batch_resumable(
             boards,
             self.spec,
@@ -1589,7 +1840,7 @@ class SolverEngine:
             max_iters=max_iters,
             max_depth=self.max_depth,
             keep_checkpoint=keep_checkpoint,
-            sharding=self.sharding,
+            sharding=sharding,
             locked=self.locked_candidates,
             waves=self.waves,
             naked_pairs=self.naked_pairs,

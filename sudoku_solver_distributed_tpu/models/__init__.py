@@ -1,9 +1,15 @@
 """Host-side model components: trusted oracle solver, puzzle generator, corpora."""
 
-from .oracle import oracle_solve, oracle_is_valid_solution, count_solutions
+from .oracle import (
+    OracleBudgetExceeded,
+    count_solutions,
+    oracle_is_valid_solution,
+    oracle_solve,
+)
 from .generator import generate_board, generate_batch
 
 __all__ = [
+    "OracleBudgetExceeded",
     "oracle_solve",
     "oracle_is_valid_solution",
     "count_solutions",

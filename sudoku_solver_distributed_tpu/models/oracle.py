@@ -12,9 +12,28 @@ oracle and kernel is meaningful evidence of correctness.
 from __future__ import annotations
 
 import math
+import time
 from typing import List, Optional, Sequence
 
 Board = List[List[int]]
+
+# deadline-check cadence for budgeted solves: one time.monotonic() per
+# this many MRV steps keeps the check under ~1 ns amortized per step while
+# still bounding overrun to a few hundred microseconds of host work
+_BUDGET_CHECK_EVERY = 128
+
+
+class OracleBudgetExceeded(Exception):
+    """A budgeted ``oracle_solve`` ran past its wall-time budget.
+
+    The host MRV backtracker's worst case is exponential (adversarial
+    16×16/25×25 refutations), and its serving-path callers — the
+    supervisor's degraded-mode fallback (serving/health.py) — must answer
+    a clean 503 instead of pinning a host core for minutes (PR 5 known
+    limit, closed in ISSUE 8). Deliberately NOT a subclass of ValueError
+    or RuntimeError: a budget trip means "undetermined", never "invalid
+    board" or "no solution", and callers must not conflate them.
+    """
 
 
 def _geometry(board: Sequence[Sequence[int]]):
@@ -64,9 +83,24 @@ def _masks(board: Sequence[Sequence[int]], size: int, box: int):
     return rows, cols, boxes
 
 
-def oracle_solve(board: Sequence[Sequence[int]]) -> Optional[Board]:
-    """Return a solved copy, or None if unsatisfiable. MRV backtracking."""
+def oracle_solve(
+    board: Sequence[Sequence[int]], budget_s: Optional[float] = None
+) -> Optional[Board]:
+    """Return a solved copy, or None if unsatisfiable. MRV backtracking.
+
+    ``budget_s`` bounds wall time: past it the search raises
+    :class:`OracleBudgetExceeded` (checked every ``_BUDGET_CHECK_EVERY``
+    MRV steps — amortized free, bounded overrun). None (default): the old
+    unbudgeted contract, unchanged for every test-oracle caller."""
     size, box = _geometry(board)
+    deadline = None
+    if budget_s is not None:
+        if budget_s <= 0:
+            raise OracleBudgetExceeded(
+                f"oracle budget {budget_s}s already spent"
+            )
+        deadline = time.monotonic() + budget_s
+    steps = 0
     grid = [list(r) for r in board]
     m = _masks(grid, size, box)
     if m is None:
@@ -76,6 +110,19 @@ def oracle_solve(board: Sequence[Sequence[int]]) -> Optional[Board]:
     empties = [(i, j) for i in range(size) for j in range(size) if not grid[i][j]]
 
     def step() -> bool:
+        nonlocal steps
+        if deadline is not None:
+            steps += 1
+            # first check at step 1 (an already-blown budget trips before
+            # any work — deterministic for callers and tests), then every
+            # _BUDGET_CHECK_EVERY steps (amortized free)
+            if steps % _BUDGET_CHECK_EVERY in (0, 1) and (
+                time.monotonic() > deadline
+            ):
+                raise OracleBudgetExceeded(
+                    f"oracle budget {budget_s}s exceeded after "
+                    f"{steps} MRV steps"
+                )
         best = -1
         best_cand = 0
         best_n = size + 1

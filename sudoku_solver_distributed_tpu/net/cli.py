@@ -55,6 +55,20 @@ Extensions (defaults preserve reference behavior):
                 coordinator ("host:port") so the engine's mesh spans a pod
                 slice; the P2P/HTTP control plane is unchanged (SURVEY.md §5
                 distributed-backend row)
+  --no-mesh     disable mesh-parallel bucket serving (ISSUE 8). DEFAULT ON
+                with >1 device: every bucket program is a shard_map over
+                the data axis, so one coalesced micro-batch splits across
+                all local chips (and, multi-host, fans out pod-wide
+                through the SPMD serving loop) instead of leaving N−1
+                idle; bucket widths round up to mesh-divisible multiples
+                (observable at /metrics engine.mesh). --no-mesh restores
+                the single-device bucket programs for A/B
+  --fallback-budget-s
+                with --supervise-engine: wall-time budget per host-oracle
+                fallback solve while DEGRADED/LOST (default 30 s) — an
+                adversarial 16×16/25×25 board answers a clean 503 instead
+                of pinning a host core on the oracle's exponential tail;
+                0 disables the budget
   --no-obs      disable the request-lifecycle tracing plane (obs/): span
                 recording across admission→coalesce→device→verify, the
                 X-Timing breakdown, the /metrics obs block + stage
@@ -239,6 +253,25 @@ def build_parser() -> argparse.ArgumentParser:
         "fallback solves while DEGRADED/LOST (bounded — the fallback "
         "keeps the node answering, it does not pretend the host is a "
         "TPU)",
+    )
+    parser.add_argument(
+        "--fallback-budget-s",
+        type=float,
+        default=30.0,
+        help="with --supervise-engine: wall-time budget per host-oracle "
+        "fallback solve (serving/health.py); a degraded node answers "
+        "503 on boards whose MRV refutation runs past it instead of "
+        "pinning a host core (0 = unbudgeted)",
+    )
+    parser.add_argument(
+        "--no-mesh",
+        action="store_true",
+        help="disable mesh-parallel bucket serving: single-device bucket "
+        "programs even with >1 device (the pre-ISSUE-8 serving substrate, "
+        "for A/B). Default: with more than one device, bucket batches "
+        "dispatch through shard_map over every local chip "
+        "(parallel/shard.py) and bucket widths round to mesh-divisible "
+        "multiples",
     )
     parser.add_argument(
         "--http-workers",
@@ -426,6 +459,41 @@ def main(argv=None) -> None:
         kwargs["buckets"] = tuple(int(b) for b in args.buckets.split(","))
     multi_host = bool(args.coordinator) and args.num_hosts > 1
     serving_loop = None
+    mesh_serving = not args.no_mesh and args.backend == "xla"
+    mesh_fanout = False
+    if mesh_serving:
+        import jax
+
+        if multi_host:
+            # Pod-slice mesh serving: the engine's OWN programs run on
+            # this host's local devices (every host warms and serves them
+            # independently — a global collective cannot be compiled
+            # outside the lockstep loop), while bucket widths round to
+            # the GLOBAL device count so leader fan-out batches divide
+            # the pod-wide mesh (parallel/serving_loop.py batch lane).
+            # The fan-out lane's broadcasts ARE multiprocess collectives,
+            # unimplemented on the CPU backend (jax 0.4.37) — arming it
+            # there would kill the loop (and the leader) at the first
+            # warm, so CPU pods keep local-mesh serving only (the sim
+            # harness is unaffected: fake devices are single-process).
+            from ..parallel import default_mesh
+
+            local = jax.local_devices()
+            if len(local) > 1:
+                kwargs["mesh"] = default_mesh(local)
+            mesh_fanout = jax.default_backend() != "cpu"
+            if mesh_fanout:
+                kwargs["bucket_multiple"] = jax.device_count()
+            else:
+                logging.getLogger(__name__).warning(
+                    "mesh serving: CPU backend cannot run cross-process "
+                    "collectives — leader batch fan-out disabled, each "
+                    "host serves its local mesh"
+                )
+        else:
+            # single host: every bucket program shard_maps over all local
+            # devices when more than one is present (engine mesh="auto")
+            kwargs["mesh"] = "auto"
     if args.frontier > 0 and not multi_host:
         from ..parallel import default_mesh
 
@@ -436,10 +504,11 @@ def main(argv=None) -> None:
         kwargs["frontier_escalate_iters"] = args.frontier_escalate_iters
         kwargs["frontier_handoff"] = args.frontier_handoff
     engine = SolverEngine(**kwargs)
-    if args.frontier > 0 and multi_host:
-        # The racer is a collective over the global mesh: every host enters
-        # it in lockstep through the SPMD serving loop, and the leader's
-        # HTTP thread feeds requests into it (parallel/serving_loop.py).
+    if multi_host and (args.frontier > 0 or mesh_fanout):
+        # Collectives over the global mesh — the frontier race and (ISSUE
+        # 8) the coalesced-batch fan-out — must be entered by every host
+        # in lockstep: the SPMD serving loop broadcasts each request and
+        # the leader's HTTP thread feeds it (parallel/serving_loop.py).
         # Non-leader hosts serve /solve from their local bucket path.
         from ..parallel import FrontierServingLoop, default_mesh
 
@@ -448,16 +517,61 @@ def main(argv=None) -> None:
         serving_loop = FrontierServingLoop(
             default_mesh(),
             engine.spec,
-            states_per_device=args.frontier,
+            states_per_device=max(args.frontier, 1),
             max_depth=engine.max_depth,
             locked=engine.locked_candidates,
             waves=engine.waves,
             naked_pairs=engine.naked_pairs,
         )
-        serving_loop.start()
+        if mesh_fanout:
+            # arm the batch lane on EVERY host before the loop starts:
+            # the sharded bucket program all hosts will enter when a
+            # leader batch header lands
+            serving_loop.enable_batch_fanout(engine)
+        serving_loop.start(warm_race=args.frontier > 0)
         if serving_loop.is_leader:
-            engine.frontier_runner = serving_loop.solve
+            if args.frontier > 0:
+                engine.frontier_runner = serving_loop.solve
             engine.frontier_loop = serving_loop
+            if mesh_fanout:
+                # leader: bucket dispatches ride the loop so every pod
+                # host's devices join each coalesced batch. The global
+                # program retraces per bucket width, and a
+                # first-at-this-width batch compiling inside the serving
+                # path would hold the loop's mutex for the whole pod-wide
+                # compile (and, supervised, read as a hung call: the
+                # width is already warmup-marked by the LOCAL
+                # engine.warmup, so the first-call hang exemption would
+                # not apply) — so warm EVERY width, tiered like
+                # engine.warmup: the smallest synchronously (serving is
+                # provably live before the HTTP server opens), the rest
+                # in the background in ladder order (each warm owns the
+                # loop mutex only for its own compile; an early real
+                # batch at a not-yet-warm width just queues behind it)
+                engine.mesh_runner = serving_loop.solve_padded
+                serving_loop.warm_batch_fanout(
+                    engine.buckets[0], engine.max_iters
+                )
+
+                def _warm_remaining_widths():
+                    for _b in engine.buckets[1:]:
+                        try:
+                            serving_loop.warm_batch_fanout(
+                                _b, engine.max_iters
+                            )
+                        except Exception:  # noqa: BLE001 — warm only
+                            logging.getLogger(__name__).warning(
+                                "background fan-out warm failed at "
+                                "width %d", _b, exc_info=True,
+                            )
+                            return
+
+                if len(engine.buckets) > 1:
+                    threading.Thread(
+                        target=_warm_remaining_widths,
+                        daemon=True,
+                        name="fanout-warm",
+                    ).start()
     from ..utils.profiling import RequestMetrics
 
     # request-lifecycle tracing plane (obs/, ISSUE 6): default ON — the
@@ -491,6 +605,7 @@ def main(argv=None) -> None:
             breaker_threshold=args.breaker_threshold,
             probe_interval_s=args.probe_interval_s,
             fallback_concurrency=args.fallback_concurrency,
+            fallback_budget_s=args.fallback_budget_s or None,
         )
         if admission is not None:
             # every regime change — device lost AND device re-admitted —

@@ -232,9 +232,27 @@ def _solve_core(p2p_node, body: bytes, deadline_s, outcome=None):
         return 400, {"error": "Invalid request"}, True, False
     if outcome is not None:
         outcome["served"] = True  # past validation: the engine runs now
-    solution, info = p2p_node.peer_sudoku_solve_info(
-        sudoku, deadline_s=deadline_s
-    )
+    from ..models.oracle import OracleBudgetExceeded
+
+    try:
+        solution, info = p2p_node.peer_sudoku_solve_info(
+            sudoku, deadline_s=deadline_s
+        )
+    except OracleBudgetExceeded:
+        # degraded-mode serving hit its host-oracle time budget
+        # (serving/health.py fallback_budget_s, ISSUE 8): the node is in
+        # fallback AND this board's host solve is adversarial-deep —
+        # answer a clean 503 instead of pinning a bounded transport
+        # worker on an exponential MRV tail. 503, not 429: the client
+        # did nothing wrong and the node is not overloaded — it is
+        # temporarily unable to serve THIS class of request correctly.
+        logger.warning("503: degraded and over the fallback budget")
+        return (
+            503,
+            {"error": "Degraded: fallback budget exceeded"},
+            True,
+            True,
+        )
     degraded = bool(info.get("degraded"))
     logger.debug("execution time: %s", time.time() - t_in)
     if solution:

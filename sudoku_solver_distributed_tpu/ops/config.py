@@ -193,6 +193,29 @@ def resolve_solver_overrides(config) -> dict:
     return config
 
 
+# ---------------------------------------------------------------------------
+# Mesh serving policy (PR 8): the data-parallel bucket plane.
+#
+# ``auto_min_devices`` — the device count at which ``SolverEngine(mesh=
+# "auto")`` (the CLI serving default) engages the sharded bucket programs:
+# below it a mesh buys nothing and only adds shard_map plumbing to every
+# trace. ``min_per_device_fill`` — bucket widths are rounded UP to a
+# multiple of the mesh size times this, so every device always receives at
+# least this many rows per dispatch (1 = plain divisibility; raise it on
+# backends where a 1-row shard underfills the vector unit). ONE definition
+# site, same contract as SERVING_CONFIG above: the engine, the CLI, and
+# bench.py --mode mesh-scaling all read it.
+MESH_SERVING = dict(
+    auto_min_devices=2,
+    min_per_device_fill=1,
+)
+
+
+def mesh_serving_config() -> dict:
+    """The mesh-serving policy knobs (engine.SolverEngine mesh="auto")."""
+    return dict(MESH_SERVING)
+
+
 # The legacy (pre-PR7) loop shape, in one place: ops/solver._solve_impl
 # traces it and engine.solver_loop_info()/_program_config() key AOT
 # artifacts on it — they must agree by construction, not by parallel

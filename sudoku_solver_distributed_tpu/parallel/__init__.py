@@ -17,7 +17,7 @@ device programs.
 """
 
 from .mesh import default_mesh, data_sharding
-from .shard import make_sharded_solver
+from .shard import make_packed_serving_program, make_sharded_solver
 from .frontier import frontier_solve, seed_frontier, state_handoff_frontier
 from .serving_loop import FrontierServingLoop
 from .coalescer import BatchCoalescer
@@ -25,6 +25,7 @@ from .coalescer import BatchCoalescer
 __all__ = [
     "default_mesh",
     "data_sharding",
+    "make_packed_serving_program",
     "make_sharded_solver",
     "frontier_solve",
     "seed_frontier",

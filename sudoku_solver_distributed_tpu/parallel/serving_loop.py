@@ -1,12 +1,15 @@
-"""SPMD multi-host serving loop for the frontier race.
+"""SPMD multi-host serving loop: frontier race + coalesced batch fan-out.
 
-The frontier racer (frontier.py) is a collective program over the mesh; on a
-multi-host pod slice every host must enter it in lockstep, but a `/solve`
-arrives at ONE host's HTTP thread. This module closes that gap the standard
-SPMD-serving way: every host runs the same loop —
+The mesh-collective programs (the frontier racer, and — since ISSUE 8 —
+the sharded bucket programs) must be entered by every host of a pod slice
+in lockstep, but a `/solve` arrives at ONE host's HTTP thread. This module
+closes that gap the standard SPMD-serving way: every host runs the same
+loop —
 
-    tick:    payload = broadcast_one_to_all(request | idle)   # host 0 feeds
-    if request: frontier_solve(board)                          # collective
+    tick:    header = broadcast_one_to_all(request | batch | idle)  # host 0
+    request: frontier_solve(board)                          # collective
+    batch:   boards = broadcast_one_to_all(...);            # second hop
+             sharded bucket program over the global mesh    # collective
     host 0:  hand the result back to the waiting HTTP thread
 
 so the other hosts follow host 0 into every collective at the same point in
@@ -14,10 +17,19 @@ the program, and the reference-compatible HTTP surface stays exactly where
 it was (one node answers the client; the mesh does the work). This is the
 TPU-native analog of the reference's master/worker UDP hop (reference
 node.py:427-475): the "dispatch" is a broadcast over DCN, the "work" rides
-ICI inside the racer, and the "collect" is the racer's own all_gather.
+ICI inside the racer/bucket program, and the "collect" is the collective's
+own gather.
+
+The batch lane (``enable_batch_fanout`` + ``solve_padded``) is how the
+request coalescer's micro-batches reach every pod host's devices: the
+leader's ``engine._dispatch_padded`` hands the PADDED bucket batch here
+(``engine.mesh_runner``), the loop broadcasts it, and all hosts run ONE
+sharded bucket program (parallel/shard.make_packed_serving_program — the
+same memoized program the single-host mesh engine dispatches, so fan-out
+can never serve a different solver than local dispatch).
 
 Single-host meshes don't need any of this — the engine calls
-``frontier_solve`` directly (engine.py).
+``frontier_solve`` / its own sharded bucket programs directly (engine.py).
 """
 
 from __future__ import annotations
@@ -34,7 +46,7 @@ from ..ops import BoardSpec, SPEC_9
 
 logger = logging.getLogger(__name__)
 
-_IDLE, _REQUEST, _STOP = 0, 1, 2
+_IDLE, _REQUEST, _STOP, _BATCH = 0, 1, 2, 3
 _POLL_S = 0.05  # idle tick cadence; latency floor for a quiet cluster
 
 
@@ -81,6 +93,7 @@ class FrontierServingLoop:
         self.collective_stall_after_s = collective_stall_after_s
         self.is_leader = jax.process_index() == 0
         self.restarts = 0
+        self.batches = 0  # coalesced batches fanned out (ISSUE 8)
         self._last_tick = time.monotonic()
         self._collective_since: Optional[float] = None
         self._requests: queue.Queue = queue.Queue()
@@ -88,18 +101,28 @@ class FrontierServingLoop:
         self._solve_mutex = threading.Lock()
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # batch fan-out lane (enable_batch_fanout): the sharded bucket
+        # program every host runs when a _BATCH header lands
+        self._batch_program = None
+        self._batch_sharding = None
+        self._pending_batch = None  # leader: boards riding the next header
 
     # -- internals ---------------------------------------------------------
-    def _payload(self, flag: int, board=None, req_id: int = 0) -> np.ndarray:
-        # [flag | request id | flattened board]: the id lets the leader
-        # match results to requests, so a late result from a timed-out
-        # solve can never be handed to the next caller
+    def _payload(
+        self, flag: int, board=None, req_id: int = 0, a: int = 0, b: int = 0
+    ) -> np.ndarray:
+        # [flag | request id | a | b | flattened board]: the id lets the
+        # leader match results to requests, so a late result from a
+        # timed-out solve can never be handed to the next caller; a/b are
+        # per-flag extras (batch lane: bucket width + iteration budget)
         C = self.spec.cells
-        buf = np.zeros((C + 2,), np.int32)
+        buf = np.zeros((C + 4,), np.int32)
         buf[0] = flag
         buf[1] = req_id
+        buf[2] = a
+        buf[3] = b
         if board is not None:
-            buf[2:] = np.asarray(board, np.int32).reshape(C)
+            buf[4:] = np.asarray(board, np.int32).reshape(C)
         return buf
 
     def _solve_collective(self, board: np.ndarray):
@@ -116,6 +139,41 @@ class FrontierServingLoop:
             naked_pairs=self.naked_pairs,
         )
 
+    def _solve_batch_collective(self, header: np.ndarray) -> np.ndarray:
+        """The batch lane's collective: second broadcast carries the
+        padded bucket batch, then every host runs the ONE sharded bucket
+        program over the global mesh. Returns the packed host rows
+        (engine packed-row contract: [grid | solved | status | guesses |
+        validations] per board)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        bucket, iters = int(header[2]), int(header[3])
+        C = self.spec.cells
+        N = self.spec.size
+        if self.is_leader and self._pending_batch is not None:
+            flat = np.ascontiguousarray(
+                self._pending_batch, np.int32
+            ).reshape(bucket * C)
+        else:
+            flat = np.zeros((bucket * C,), np.int32)
+        flat = np.asarray(
+            multihost_utils.broadcast_one_to_all(flat), np.int32
+        )
+        boards = flat.reshape(bucket, N, N)
+        # every host holds the full batch (just broadcast); the callback
+        # hands each addressable shard its slice — the global array is
+        # sharded over the WHOLE mesh, pod-wide
+        global_boards = jax.make_array_from_callback(
+            boards.shape, self._batch_sharding, lambda idx: boards[idx]
+        )
+        rows = self._batch_program(global_boards, jnp.int32(iters))
+        # the loop's documented sync point, mirroring the engine's
+        # _finalize_padded contract (JAX101): one device→host transfer
+        # per fanned-out batch
+        return np.asarray(jax.block_until_ready(rows))
+
     def _run_round(self) -> str:
         """One broadcast/solve loop; returns why it exited: "stop" on the
         leader's deliberate STOP broadcast, "failed" after a failed
@@ -123,9 +181,12 @@ class FrontierServingLoop:
         from jax.experimental import multihost_utils
 
         while True:
+            self._pending_batch = None
             if self.is_leader:
                 try:
-                    payload = self._requests.get(timeout=_POLL_S)
+                    payload, self._pending_batch = self._requests.get(
+                        timeout=_POLL_S
+                    )
                 except queue.Empty:
                     payload = self._payload(_IDLE)
             else:
@@ -139,13 +200,22 @@ class FrontierServingLoop:
                 return "stop"
             if flag == _IDLE:
                 continue
-            logger.info(
-                "frontier serving loop: racing a board (%d clues)",
-                int((buf[2:] > 0).sum()),
-            )
             try:
                 self._collective_since = time.monotonic()
-                result = (req_id, "ok", self._solve_collective(buf[2:]))
+                if flag == _BATCH:
+                    logger.info(
+                        "serving loop: fanning out a coalesced batch "
+                        "(%d boards)", int(buf[2]),
+                    )
+                    rows = self._solve_batch_collective(buf)
+                    self.batches += 1
+                    result = (req_id, "ok", rows)
+                else:
+                    logger.info(
+                        "frontier serving loop: racing a board (%d clues)",
+                        int((buf[4:] > 0).sum()),
+                    )
+                    result = (req_id, "ok", self._solve_collective(buf[4:]))
             except Exception as e:  # noqa: BLE001 — surfaced to caller
                 # A failed collective may leave hosts out of sync; exit the
                 # round rather than risk a deadlocked next broadcast. The
@@ -230,6 +300,52 @@ class FrontierServingLoop:
                         (-1, "error", RuntimeError("frontier serving loop died"))
                     )
 
+    # -- batch fan-out (ISSUE 8) -------------------------------------------
+    def enable_batch_fanout(self, engine) -> None:
+        """Arm the coalesced-batch lane. Call on EVERY host, with the same
+        engine configuration, BEFORE ``start()``: builds the sharded
+        bucket program over this loop's (global) mesh with the engine's
+        resolved solver knobs — the same memoized
+        ``make_packed_serving_program`` the engine's own mesh dispatch
+        uses, so the fanned-out program and the local one are one trace.
+        The CLI then points ``engine.mesh_runner`` at ``solve_padded`` on
+        the leader (net/cli.py)."""
+        from .mesh import data_sharding
+        from .shard import make_packed_serving_program
+
+        self._batch_sharding = data_sharding(self.mesh)
+        self._batch_program = make_packed_serving_program(
+            self.mesh,
+            engine.spec,
+            max_depth=engine.max_depth,
+            locked_candidates=engine.locked_candidates,
+            waves=engine.waves,
+            naked_pairs=engine.naked_pairs,
+            solver_overrides=tuple(sorted(engine.solver_overrides.items())),
+        )
+
+    def solve_padded(
+        self, boards: np.ndarray, iters: int, timeout: float = 600.0
+    ) -> np.ndarray:
+        """Leader-only: fan one PADDED bucket batch out across the whole
+        mesh (every pod host enters the sharded bucket program through
+        the broadcast). ``boards`` is (bucket, N, N) with bucket divisible
+        by the mesh size — exactly what ``engine._dispatch_padded`` hands
+        its ``mesh_runner``. Returns the packed (bucket, C+4) host rows.
+
+        Same serialization/timeout contract as ``solve``: raises if the
+        loop died or the collective failed, never hangs the caller."""
+        if self._batch_program is None:
+            raise RuntimeError(
+                "batch fan-out not armed — call enable_batch_fanout() on "
+                "every host before start()"
+            )
+        boards = np.asarray(boards, np.int32)
+        header = self._payload(
+            _BATCH, a=int(boards.shape[0]), b=int(iters)
+        )
+        return self._roundtrip(header, boards, timeout)
+
     # -- public API --------------------------------------------------------
     def health(self) -> dict:
         """Liveness for operator surfaces (engine.health → /metrics).
@@ -259,16 +375,36 @@ class FrontierServingLoop:
             "stalled": stalled,
             "last_tick_age_s": round(now - self._last_tick, 1),
             "restarts": self.restarts,
+            "batches": self.batches,
         }
 
-    def start(self) -> None:
+    def start(self, warm_race: bool = True) -> None:
         """Start the loop thread (every host). Leader warms the collective
         path by racing one empty board through the loop so the first real
-        request hits compiled programs on every host."""
+        request hits compiled programs on every host; ``warm_race=False``
+        skips that (a batch-fanout-only loop — CLI mesh serving without
+        --frontier — has no racer to warm; its bucket programs warm
+        through ``warm_batch_fanout`` instead)."""
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
-        if self.is_leader:
+        if self.is_leader and warm_race:
             self.solve(np.zeros((self.spec.size, self.spec.size), np.int32))
+
+    def warm_batch_fanout(self, bucket: int, iters: int) -> None:
+        """Leader-only, after ``start()``: push one bucket batch of
+        instantly-UNSAT pad boards (ops/solver.pad_board — dead after a
+        single sweep; an EMPTY board would pay ``bucket`` full DFS solves
+        pod-wide when the warm's only purpose is the compile) through the
+        fan-out lane so every host compiles the sharded bucket program
+        before real traffic hits it (the same contract as the race warmup
+        above — first request must not pay the pod-wide compile)."""
+        from ..ops.solver import pad_board
+
+        boards = np.broadcast_to(
+            np.asarray(pad_board(self.spec), np.int32),
+            (bucket, self.spec.size, self.spec.size),
+        )
+        self.solve_padded(np.ascontiguousarray(boards), iters)
 
     def solve(self, board, timeout: float = 600.0):
         """Leader-only: run one board through the collective race.
@@ -278,6 +414,14 @@ class FrontierServingLoop:
         concurrent callers must not interleave (each call owns the loop for
         its duration). Raises if the loop died or the collective failed —
         never hangs the HTTP thread."""
+        return self._roundtrip(
+            self._payload(_REQUEST, board), None, timeout
+        )
+
+    def _roundtrip(self, header: np.ndarray, extra, timeout: float):
+        """Submit one request (race or batch fan-out) and await ITS
+        result — the shared leader-side machinery both public entry
+        points use."""
         assert self.is_leader, "solve() is for process 0; others follow"
         import time as _time
 
@@ -286,7 +430,8 @@ class FrontierServingLoop:
                 raise RuntimeError("frontier serving loop is stopped")
             self._req_seq = getattr(self, "_req_seq", 0) + 1
             my_id = self._req_seq
-            self._requests.put(self._payload(_REQUEST, board, req_id=my_id))
+            header[1] = my_id
+            self._requests.put((header, extra))
             deadline = _time.monotonic() + timeout
 
             def _next(block_s: float):
@@ -332,7 +477,7 @@ class FrontierServingLoop:
     def stop(self) -> None:
         """Leader-only: stop the loop on every host (via the broadcast)."""
         if self.is_leader and not self._stopped.is_set():
-            self._requests.put(self._payload(_STOP))
+            self._requests.put((self._payload(_STOP), None))
         self._stopped.wait(timeout=30)
 
     def join(self, timeout: Optional[float] = None) -> None:

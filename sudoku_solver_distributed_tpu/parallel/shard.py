@@ -7,6 +7,26 @@ embarrassingly parallel compute with two tiny collectives at the end
 in one transfer. This is the TPU-native form of the reference's task farm
 (reference node.py:427-475): what was one UDP ``solve``/``solution`` message
 pair per cell per peer is now one sharded device program per batch.
+
+Two factories:
+
+  * :func:`make_sharded_solver` — the library surface: ``fn(grids) ->
+    (solutions, solved, stats)`` with rich replicated counters. Since ISSUE 8
+    it pads non-mesh-divisible batches internally (instantly-UNSAT pad
+    boards, masked out of every counter) instead of failing the shard_map
+    divisibility check with an opaque error, and carries the full PR 7
+    hot-loop configuration (compaction ladder / packed bitplanes /
+    naked pairs / legacy escape hatch) so a sharded A/B measures the same
+    loop the serving engine runs.
+  * :func:`make_packed_serving_program` — the serving surface: the engine's
+    packed-row bucket program (one (B, C+4) int32 output = ONE device→host
+    transfer per batch, iteration budget as a traced argument) shard_mapped
+    over the ``data`` axis. ``engine._dispatch_padded`` dispatches through
+    it when the engine owns a mesh, and the multi-host serving loop
+    (serving_loop.py) compiles the same program over the global mesh so a
+    leader's coalesced batches fan out across pod hosts. ONE implementation
+    for both, memoized, so the single-chip and mesh programs can never
+    drift.
 """
 
 from __future__ import annotations
@@ -15,13 +35,108 @@ from functools import lru_cache, partial
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import BoardSpec, SPEC_9, solve_batch
 from .compat import shard_map
 
 
+def mesh_batch_multiple(mesh: Mesh) -> int:
+    """The batch-width divisor a ``data``-sharded program needs: one row
+    block per device."""
+    return int(mesh.devices.size)
+
+
+def pad_to_mesh(grids, mesh: Mesh, spec: BoardSpec):
+    """Pad a (B, N, N) batch up to the next mesh-divisible width with
+    instantly-UNSAT boards (ops/solver.pad_board — two equal clues in one
+    row, dead after a single sweep, so pad lanes never dominate the batch
+    they ride in). Returns ``(padded_grids, real_mask)`` where the int32
+    mask is 1 for real rows — counters multiply by it so pad lanes are
+    invisible in every reported stat."""
+    from ..ops.solver import pad_board
+
+    grids = jnp.asarray(grids)
+    B = int(grids.shape[0])
+    n = mesh_batch_multiple(mesh)
+    Bp = -(-B // n) * n
+    mask = jnp.concatenate(
+        [jnp.ones((B,), jnp.int32), jnp.zeros((Bp - B,), jnp.int32)]
+    )
+    if Bp == B:
+        return grids, mask
+    pad = jnp.broadcast_to(pad_board(spec), (Bp - B, spec.size, spec.size))
+    return jnp.concatenate([grids, pad], axis=0), mask
+
+
 @lru_cache(maxsize=None)
+def _sharded_solver_cached(
+    mesh: Mesh,
+    spec: BoardSpec,
+    max_depth,
+    max_iters: int,
+    locked_candidates: bool,
+    waves: int,
+    naked_pairs,
+    packed,
+    compact_div,
+    compact_floor,
+    compact_every,
+    legacy_loop: bool,
+):
+    """The compiled core of ``make_sharded_solver``: memoized on every knob
+    (same contract as frontier._make_racer_cached, found by
+    analysis/jax_hygiene.py JAX104) so two calls with identical arguments
+    share one trace. Takes ``(grids, mask)`` with a mesh-divisible batch;
+    the public wrapper pads and builds the mask."""
+    data_spec = P("data")
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(data_spec, data_spec),
+        out_specs=(data_spec, data_spec, P()),
+        # the solver's while_loop carry starts as unvarying zeros and becomes
+        # device-varying; skip the strict VMA typecheck rather than pcast
+        # every stack buffer
+        check_vma=False,
+    )
+    def _solve_shard(grids, mask):
+        # packed/compact_*/legacy_loop carry the --solver-config hot-loop
+        # flavor (PR 7) so a legacy A/B covers the sharded path too
+        res, lstats = solve_batch(
+            grids, spec, max_iters=max_iters, max_depth=max_depth,
+            locked_candidates=locked_candidates, waves=waves,
+            naked_pairs=naked_pairs, packed=packed,
+            compact_div=compact_div, compact_floor=compact_floor,
+            compact_every=compact_every, legacy_loop=legacy_loop,
+            return_stats=True,
+        )
+        real = mask > 0
+        stats = {
+            # per-board counters masked so internal pad lanes (a
+            # non-divisible batch rounded up) contribute exactly nothing
+            "solved": jax.lax.psum((res.solved & real).sum(), "data"),
+            "validations": jax.lax.psum(
+                (res.validations * mask).sum(), "data"
+            ),
+            "guesses": jax.lax.psum((res.guesses * mask).sum(), "data"),
+            # loop-level work counters (PR 7 LoopStats): whole-shard
+            # scalars, so pad lanes ride along — each is instantly-UNSAT
+            # and bills ~one iteration; the idle-lane evidence the mesh
+            # bench reads (bench.py --mode mesh-scaling)
+            "lane_steps": jax.lax.psum(lstats.lane_steps, "data"),
+            "idle_lane_steps": jax.lax.psum(
+                lstats.idle_lane_steps, "data"
+            ),
+        }
+        return res.grid, res.solved, stats
+
+    return jax.jit(_solve_shard)
+
+
 def make_sharded_solver(
     mesh: Mesh,
     spec: BoardSpec = SPEC_9,
@@ -30,53 +145,128 @@ def make_sharded_solver(
     max_iters: int = 4096,
     locked_candidates: bool = True,
     waves: int = 3,
+    naked_pairs: Optional[bool] = None,
     packed: Optional[bool] = None,
+    compact_div: Optional[int] = None,
+    compact_floor: Optional[int] = None,
+    compact_every: Optional[int] = None,
     legacy_loop: bool = False,
 ):
-    """Compile a mesh-sharded batch solver.
+    """Build a mesh-sharded batch solver.
 
     Returns ``fn(grids) -> (solutions, solved, stats)`` where grids is
-    (B, N, N) with B divisible by the mesh's ``data`` axis size; solutions and
-    solved come back sharded (device-resident), and ``stats`` is a replicated
-    dict of scalar counters (solved count, validation sweeps, guesses) reduced
-    with ``psum`` over the mesh — the device-side analog of the reference's
-    stats gossip aggregation (reference node.py:264-328).
+    (B, N, N) for ANY B: a batch that does not divide the mesh's ``data``
+    axis is padded internally with instantly-UNSAT boards up to the next
+    mesh-divisible width (the old contract rejected it deep inside
+    shard_map with an opaque divisibility error), and the outputs are
+    sliced back to B rows. Solutions and solved come back device-resident
+    (sharded when no slicing was needed); ``stats`` is a replicated dict of
+    scalar counters reduced with ``psum`` over the mesh — solved count,
+    validation sweeps, guesses (pad lanes masked out exactly), plus the
+    PR 7 ``lane_steps``/``idle_lane_steps`` loop-work counters — the
+    device-side analog of the reference's stats gossip aggregation
+    (reference node.py:264-328).
 
     ``locked_candidates``/``waves`` default to the measured single-chip
-    winners (ops/solver.py; v5e 2026-07-30) so the sharded path runs the
-    same optimized kernel per shard as the serving engine.
+    winners (ops/solver.py; v5e 2026-07-30), and the PR 7 hot-loop knobs
+    (``packed``/``compact_*``/``naked_pairs``/``legacy_loop``) pass through
+    to ``solve_batch`` so the sharded path runs — and A/Bs — the same
+    optimized kernel per shard as the serving engine.
+    """
+    solver = _sharded_solver_cached(
+        mesh, spec, max_depth, max_iters, locked_candidates, waves,
+        naked_pairs, packed, compact_div, compact_floor, compact_every,
+        legacy_loop,
+    )
 
-    Memoized on every knob (same contract as frontier._make_racer_cached,
-    found by analysis/jax_hygiene.py JAX104): each call used to build a
-    fresh ``_solve_shard`` closure, so two calls with identical arguments
-    compiled two identical programs — callers that construct a solver
-    per batch now share one trace per configuration.
+    def fn(grids):
+        grids = jnp.asarray(grids)
+        B = int(grids.shape[0])
+        padded, mask = pad_to_mesh(grids, mesh, spec)
+        solutions, solved, stats = solver(padded, mask)
+        if padded.shape[0] != B:
+            solutions = solutions[:B]
+            solved = solved[:B]
+        return solutions, solved, stats
+
+    return fn
+
+
+@lru_cache(maxsize=None)
+def make_packed_serving_program(
+    mesh: Mesh,
+    spec: BoardSpec,
+    *,
+    max_depth,
+    locked_candidates: bool,
+    waves: int,
+    naked_pairs,
+    solver_overrides: tuple = (),
+):
+    """The engine's packed-row bucket program, shard_mapped over ``data``.
+
+    Returns a jitted ``fn(grids, iters) -> (B, C+4) int32`` where grids is
+    (B, N, N) with B divisible by the mesh size, each row is
+    ``[grid | solved | status | guesses | validations]`` (ONE device→host
+    transfer per batch — the engine serving contract), and ``iters`` is the
+    TRACED iteration budget so the normal/deep/quick variants share this
+    one executable (the PR 4 compile-cost collapse, preserved on the mesh).
+
+    ``solver_overrides`` is the engine's resolved --solver-config dict as a
+    sorted item tuple (hashable for the memoizer): the mesh program runs
+    exactly the hot-loop flavor the single-chip program would.
+
+    Memoized on every knob: the engine builds it once per engine, and the
+    multi-host serving loop (serving_loop.py) builds the SAME program over
+    the global mesh — identical trace by construction, so leader fan-out
+    can never serve a different solver than local dispatch.
     """
     data_spec = P("data")
+    overrides = dict(solver_overrides)
+    cells = spec.cells
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(data_spec,),
-        out_specs=(data_spec, data_spec, P()),
-        # the solver's while_loop carry starts as unvarying zeros and becomes
-        # device-varying; skip the strict VMA typecheck rather than pcast
-        # every stack buffer
-        check_vma=False,
-    )
-    def _solve_shard(grids):
-        # packed/legacy_loop carry the --solver-config hot-loop flavor
-        # (PR 7) so a legacy A/B covers the sharded path too
+    def _run_shard(grid, iters):
+        B = grid.shape[0]
         res = solve_batch(
-            grids, spec, max_iters=max_iters, max_depth=max_depth,
+            grid, spec, max_iters=iters, max_depth=max_depth,
             locked_candidates=locked_candidates, waves=waves,
-            packed=packed, legacy_loop=legacy_loop,
+            naked_pairs=naked_pairs, **overrides,
         )
-        stats = {
-            "solved": jax.lax.psum(res.solved.sum(), "data"),
-            "validations": jax.lax.psum(res.validations.sum(), "data"),
-            "guesses": jax.lax.psum(res.guesses.sum(), "data"),
-        }
-        return res.grid, res.solved, stats
+        # the engine's packed result row (engine._run): every field in ONE
+        # int32 array so the serving path pays exactly one transfer
+        return jnp.concatenate(
+            [
+                res.grid.reshape(B, cells),
+                res.solved[:, None].astype(jnp.int32),
+                res.status[:, None],
+                res.guesses[:, None],
+                res.validations[:, None],
+            ],
+            axis=1,
+        )
 
-    return jax.jit(_solve_shard)
+    return jax.jit(
+        partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(data_spec, P()),
+            out_specs=data_spec,
+            check_vma=False,
+        )(_run_shard)
+    )
+
+
+def split_evidence(packed) -> dict:
+    """How a dispatched batch actually landed on the mesh, read from the
+    output array's sharding metadata (no transfer, no sync): device count
+    and rows per device. The counter evidence ``bench.py --mode
+    mesh-scaling`` and ``engine.mesh_info()`` report — "provably split
+    N ways" means XLA partitioned the OUTPUT over N devices, not that we
+    asked nicely."""
+    try:
+        sharding = packed.sharding
+        ndev = len(sharding.device_set)
+        rows = int(sharding.shard_shape(packed.shape)[0])
+    except Exception:  # noqa: BLE001 — host arrays / unplaced outputs
+        return {"devices": 1, "rows_per_device": int(np.shape(packed)[0])}
+    return {"devices": int(ndev), "rows_per_device": rows}

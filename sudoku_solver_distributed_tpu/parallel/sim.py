@@ -1,0 +1,128 @@
+"""Fake-device simulation harness: mesh logic tier-1-testable on CPU.
+
+Cross-process collectives are unimplemented on the CPU backend (jax
+0.4.37), so the true multi-host paths (tests/test_multihost.py's
+``jax.distributed`` cases) need a TPU pod slice and stay slow-marked. But
+everything that matters about the mesh serving plane — sharded bucket
+dispatch, mesh-divisible padding, AOT round-trips keyed by topology,
+leader fan-out of coalesced batches through the SPMD serving loop — is a
+SINGLE-process property: ``XLA_FLAGS=--xla_force_host_platform_device_
+count=N`` gives one process an N-device mesh, and
+``broadcast_one_to_all`` over one process is the identity, so the whole
+loop machinery runs for real.
+
+This module stands up such processes as children (fresh interpreter:
+XLA device-count flags must be set before the first jax import, and a
+cold-start assertion needs a process that has never traced). The pattern
+is lifted from tests/test_multihost.py's worker scaffolding; here it is a
+first-class helper the tier-1 suite, ``bench.py --mode mesh-scaling``,
+and operators (OPERATIONS.md "Mesh serving") all share.
+
+Deliberately jax-free: importing this module must never initialize a
+backend in the parent (the child picks its own device count).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# the shared persistent XLA cache every child inherits unless the caller
+# overrides it — compiles paid by one tier-1 run are disk hits for every
+# later one (same default as tests/conftest.py)
+_DEFAULT_XLA_CACHE = "/tmp/jax_cache_sudoku_tpu"
+
+
+def fake_device_env(
+    n_devices: int, *, compile_cache: Optional[str] = None
+) -> dict:
+    """Child-process environment for an ``n_devices``-way fake CPU mesh.
+
+    Forces the CPU platform and the virtual device count, points the
+    persistent XLA cache at a shared directory (compiles amortize across
+    children), and strips the TPU-tunnel variable so a child can never
+    wander onto real hardware (same hygiene as tests/test_multihost.py).
+    """
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={int(n_devices)}",
+        JAX_COMPILATION_CACHE_DIR=(
+            compile_cache
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR", _DEFAULT_XLA_CACHE)
+        ),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+        JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="0",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep children off the TPU tunnel
+    return env
+
+
+def run_py(
+    code: str,
+    n_devices: int,
+    *,
+    args: tuple = (),
+    timeout: float = 600.0,
+    compile_cache: Optional[str] = None,
+    check: bool = True,
+) -> subprocess.CompletedProcess:
+    """Run a Python snippet in a fresh ``n_devices``-fake-device child.
+
+    ``code`` runs with the repo root on sys.path (cwd) and receives
+    ``args`` as ``sys.argv[1:]``. Returns the CompletedProcess (stdout and
+    stderr merged into stdout so a failing child's traceback is IN the
+    assertion message); ``check=True`` raises with that output on a
+    non-zero exit.
+    """
+    proc = subprocess.run(
+        [sys.executable, "-c", code, *[str(a) for a in args]],
+        env=fake_device_env(n_devices, compile_cache=compile_cache),
+        cwd=REPO_ROOT,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=timeout,
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"fake-device child (n={n_devices}) failed "
+            f"rc={proc.returncode}:\n{proc.stdout[-4000:]}"
+        )
+    return proc
+
+
+def run_json(
+    code: str,
+    n_devices: int,
+    *,
+    args: tuple = (),
+    timeout: float = 600.0,
+    compile_cache: Optional[str] = None,
+) -> dict:
+    """``run_py`` for children that print ONE JSON object as their last
+    stdout line (the harness convention: everything above it is free-form
+    progress/log noise). Returns the parsed object."""
+    proc = run_py(
+        code, n_devices, args=args, timeout=timeout,
+        compile_cache=compile_cache,
+    )
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        raise AssertionError(
+            f"fake-device child (n={n_devices}) printed no output"
+        )
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        raise AssertionError(
+            f"fake-device child (n={n_devices}) last line is not JSON:\n"
+            f"{proc.stdout[-4000:]}"
+        ) from None

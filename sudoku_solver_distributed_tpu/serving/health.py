@@ -66,7 +66,11 @@ from typing import Optional
 
 import numpy as np
 
-from ..models.oracle import oracle_is_valid_solution, oracle_solve
+from ..models.oracle import (
+    OracleBudgetExceeded,
+    oracle_is_valid_solution,
+    oracle_solve,
+)
 from ..obs.trace import current_trace
 
 logger = logging.getLogger(__name__)
@@ -110,6 +114,14 @@ class EngineSupervisor:
         callers past it queue on the semaphore (bounded concurrency, not
         unbounded host-CPU fan-out — the fallback exists to keep
         answering, not to pretend the host is a TPU).
+      fallback_budget_s: wall-time budget per host-oracle fallback solve
+        (default 30 s). The MRV oracle's worst case is exponential —
+        an adversarial 16×16/25×25 board used to pin a host core for
+        minutes while DEGRADED (PR 5 known limit) — so a budgeted solve
+        raises ``OracleBudgetExceeded`` past it and the HTTP surface
+        answers a clean 503 (net/http_api.py) instead of holding a
+        bounded transport worker hostage. None disables the budget (the
+        pre-ISSUE-8 contract).
       auto_rebuild: on LOST, re-warm the engine once per episode through
         the compile plane before probing (engine.warmup tier 0) — a
         restarted/replaced device needs its programs back before a probe
@@ -130,6 +142,7 @@ class EngineSupervisor:
         breaker_threshold: int = 3,
         probe_interval_s: float = 2.0,
         fallback_concurrency: int = 2,
+        fallback_budget_s: Optional[float] = 30.0,
         auto_rebuild: bool = True,
     ):
         if watchdog_budget_s <= 0:
@@ -138,11 +151,14 @@ class EngineSupervisor:
             raise ValueError("breaker_threshold must be >= 1")
         if fallback_concurrency < 1:
             raise ValueError("fallback_concurrency must be >= 1")
+        if fallback_budget_s is not None and fallback_budget_s <= 0:
+            raise ValueError("fallback_budget_s must be > 0 (or None)")
         self._engine = engine
         self.watchdog_budget_s = watchdog_budget_s
         self.breaker_threshold = breaker_threshold
         self.probe_interval_s = probe_interval_s
         self.fallback_concurrency = fallback_concurrency
+        self.fallback_budget_s = fallback_budget_s
         self.auto_rebuild = auto_rebuild
 
         self._lock = threading.Lock()
@@ -160,6 +176,7 @@ class EngineSupervisor:
         self.bad_results = 0       # host-verification failures
         self.late_successes = 0    # declared-hung calls that finished OK
         self.fallback_served = 0
+        self.fallback_budget_trips = 0  # budgeted oracle solves cut off
         self.probes = 0
         self.probe_failures = 0
         self.rebuilds = 0
@@ -361,7 +378,13 @@ class EngineSupervisor:
         passed while it waited there sheds (DeadlineExceeded → 429)
         instead of being served long-expired while pinning a bounded
         transport worker — the same queue-wait-only contract as the
-        coalescer's batch-formation drop."""
+        coalescer's batch-formation drop.
+
+        The solve itself runs under ``fallback_budget_s`` (ISSUE 8): an
+        adversarial deep board trips ``OracleBudgetExceeded`` — counted,
+        propagated, answered as a clean 503 by the HTTP layer — instead
+        of pinning a host core for the exponential tail (the PR 5 known
+        limit)."""
         arr = np.asarray(board, np.int32)
         tr = current_trace()  # the request's span, when tracing is on
         t0 = time.monotonic()
@@ -372,7 +395,23 @@ class EngineSupervisor:
                 raise DeadlineExceeded(
                     "deadline expired waiting for the fallback slot"
                 )
-            solution = oracle_solve(arr.tolist())
+            try:
+                solution = oracle_solve(
+                    arr.tolist(), budget_s=self.fallback_budget_s
+                )
+            except OracleBudgetExceeded:
+                with self._lock:
+                    self.fallback_budget_trips += 1
+                if tr is not None:
+                    tr.mark("fallback", time.monotonic() - t0)
+                    tr.fallback = True
+                    tr.degraded = True
+                logger.warning(
+                    "host-oracle fallback exceeded its %.1fs budget — "
+                    "answering 503 (degraded and over budget)",
+                    self.fallback_budget_s,
+                )
+                raise
         if tr is not None:
             # fallback stage = semaphore wait + oracle solve; the flags
             # make degraded-mode serving first-class in the timeline
@@ -407,12 +446,26 @@ class EngineSupervisor:
         refutation can be exponential, and paying it per device-UNSAT
         answer on a HEALTHY node would hand clients a cheap host-CPU
         DoS — those sizes accept the device's claim (the probe plane
-        still catches poisoned programs; ROADMAP notes the gap)."""
+        still catches poisoned programs; ROADMAP notes the gap). A
+        cross-check that trips the fallback budget also accepts the
+        claim — an undetermined refutation must not 503 a request the
+        device DID answer."""
         arr = np.asarray(board, np.int32)
         if arr.shape[0] > 9:
             return None, {}
         with self._fallback_sem:
-            solution = oracle_solve(arr.tolist())
+            try:
+                solution = oracle_solve(
+                    arr.tolist(), budget_s=self.fallback_budget_s
+                )
+            except OracleBudgetExceeded:
+                with self._lock:
+                    self.fallback_budget_trips += 1
+                logger.warning(
+                    "UNSAT cross-check exceeded the fallback budget — "
+                    "accepting the device's claim"
+                )
+                return None, {}
         if solution is None:
             return None, {}
         logger.error(
@@ -657,6 +710,8 @@ class EngineSupervisor:
                 "fallback": {
                     "served": self.fallback_served,
                     "concurrency": self.fallback_concurrency,
+                    "budget_s": self.fallback_budget_s,
+                    "budget_trips": self.fallback_budget_trips,
                 },
                 "transitions": list(self._transitions),
             }

@@ -38,6 +38,18 @@ def rng():
     return np.random.default_rng(1234)
 
 
+@pytest.fixture
+def sim():
+    """The fake-device simulation harness (parallel/sim.py): run snippets
+    in fresh child processes with an N-way virtual CPU mesh — the tier-1
+    stand-in for pod topologies (cross-process collectives are
+    unimplemented on the CPU backend, so true multi-process cases stay
+    slow-marked in tests/test_multihost.py)."""
+    from sudoku_solver_distributed_tpu.parallel import sim as _sim
+
+    return _sim
+
+
 # The reference README's 8-clue example puzzle (reference README.md:20) — the
 # canonical hard input; the reference solves it in 168.4 s (BASELINE.md).
 README_PUZZLE = [

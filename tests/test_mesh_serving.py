@@ -1,0 +1,374 @@
+"""Mesh-parallel serving plane (ISSUE 8): sharded bucket programs,
+mesh-divisible padding, topology-keyed AOT, fallback budget.
+
+Runs in-process on the suite's virtual 8-device CPU mesh (conftest.py):
+the sharded-vs-single parity claims are exact bit-equality — the per-board
+search trajectory is schedule-independent (the PR 7 hotloop parity
+property), so splitting a bucket across devices must change NOTHING about
+any answer or per-board counter.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import (
+    OracleBudgetExceeded,
+    generate_batch,
+    oracle_is_valid_solution,
+    oracle_solve,
+)
+from sudoku_solver_distributed_tpu.ops import spec_for_size
+from sudoku_solver_distributed_tpu.parallel import (
+    default_mesh,
+    make_sharded_solver,
+)
+
+
+def _engines(**kw):
+    """A mesh engine and its single-device twin, same everything else."""
+    em = SolverEngine(mesh="auto", **kw)
+    es = SolverEngine(**kw)
+    return em, es
+
+
+def _ndev():
+    """Tests run on the conftest 8-device virtual mesh by default and on
+    a 4-device one in the CI mesh-smoke job — assertions derive from the
+    actual count so both topologies exercise the same contracts."""
+    return len(jax.devices())
+
+
+def test_mesh_auto_rounds_buckets_and_reports_topology():
+    em = SolverEngine(mesh="auto", buckets=(1, 8, 20), coalesce=False)
+    try:
+        n = _ndev()
+        assert n > 1  # the virtual mesh (8 in-suite, 4 in mesh-smoke)
+        assert em.requested_buckets == (1, 8, 20)
+        expected = tuple(sorted({-(-b // n) * n for b in (1, 8, 20)}))
+        assert em.buckets == expected
+        mi = em.mesh_info()
+        assert mi["devices"] == n and mi["axis"] == "data"
+        assert mi["per_device_fill"] == {
+            str(b): b // n for b in expected
+        }
+        assert mi["buckets_requested"] == [1, 8, 20]
+        # the /metrics engine block carries it
+        assert em.health()["mesh"]["devices"] == n
+        assert em.warm_info()["mesh"]["devices"] == n
+    finally:
+        em.close()
+
+
+def test_mesh_rejects_bad_axis_and_pallas():
+    from jax.sharding import Mesh
+
+    bad = Mesh(np.array(jax.devices()[:2]), ("model",))
+    with pytest.raises(ValueError, match="data"):
+        SolverEngine(mesh=bad)
+    with pytest.raises(ValueError, match="pallas"):
+        SolverEngine(mesh="auto", backend="pallas")
+
+
+def test_sharded_vs_single_parity_9x9_including_partial_bucket():
+    """Byte-identical answers AND identical work counters, divisible
+    (16 -> bucket 16) and non-divisible (11 -> padded into bucket 16)."""
+    boards = generate_batch(16, 55, seed=11)
+    em, es = _engines(buckets=(8, 16), coalesce=False)
+    try:
+        for n in (16, 11):  # full bucket, then a partial one
+            sm, mm, im = em.solve_batch_np(boards[:n])
+            ss, ms, is_ = es.solve_batch_np(boards[:n])
+            assert np.array_equal(sm, ss), f"grids diverged at n={n}"
+            assert np.array_equal(mm, ms)
+            assert im == is_, f"counters diverged at n={n}: {im} != {is_}"
+        split = em.mesh_info()["last_split"]
+        assert split["devices"] == _ndev()
+        assert split["rows_per_device"] == 16 // _ndev()
+        assert em.mesh_info()["min_devices_seen"] == _ndev()
+    finally:
+        em.close()
+        es.close()
+
+
+def test_sharded_vs_single_parity_16x16():
+    spec16 = spec_for_size(16)
+    boards = generate_batch(4, 140, size=16, seed=12)
+    em, es = _engines(spec=spec16, buckets=(4,), coalesce=False)
+    try:
+        # 4 rounds up to the next mesh-divisible width
+        assert em.buckets == (max(4, _ndev()),)
+        sm, mm, im = em.solve_batch_np(boards)
+        ss, ms, is_ = es.solve_batch_np(boards)
+        assert np.array_equal(sm, ss) and np.array_equal(mm, ms)
+        assert im == is_
+        assert bool(mm.all())
+        assert oracle_is_valid_solution(sm[0].tolist())
+    finally:
+        em.close()
+        es.close()
+
+
+def test_coalesced_serving_answers_identical_on_mesh():
+    """Concurrent /solve-path requests through the coalescer on a mesh
+    engine: every answer equals the single-device engine's, and the
+    dispatches provably split across all 8 devices."""
+    boards = generate_batch(12, 55, seed=21)
+    em, es = _engines(buckets=(8, 16), coalesce=True, coalesce_max_batch=16)
+    try:
+        futs = [em.solve_one_async(b.tolist()) for b in boards]
+        got = [f.result(timeout=120) for f in futs]
+        for b, (sol, info) in zip(boards, got):
+            ref_sol, _ = es.solve_one(b.tolist())
+            assert sol == ref_sol
+            assert info["routed"] == "coalesced"
+        stats = em.coalescer.stats()
+        assert stats["batches"] >= 1 and stats["boards"] == 12
+        mi = em.mesh_info()
+        assert mi["dispatches"] >= 1
+        assert mi["last_split"]["devices"] == _ndev()
+    finally:
+        em.close()
+        es.close()
+
+
+def test_make_sharded_solver_pads_internally_with_exact_stats():
+    """The old divisibility contract (opaque shard_map error on B % n)
+    is gone: any B pads internally, outputs slice back, and the masked
+    counters match an unsharded reference exactly."""
+    from sudoku_solver_distributed_tpu.ops import SPEC_9, solve_batch
+
+    mesh = default_mesh()
+    solve = make_sharded_solver(mesh)
+    boards = generate_batch(11, 50, seed=17)  # 11 % 8 != 0
+    grids, solved, stats = solve(boards)
+    grids = np.asarray(grids)
+    solved = np.asarray(solved)
+    assert grids.shape == (11, 9, 9) and solved.shape == (11,)
+    assert bool(solved.all())
+    for b in range(11):
+        assert oracle_is_valid_solution(grids[b].tolist())
+    # counter exactness: same kernel unsharded, pad lanes invisible
+    import jax.numpy as jnp
+
+    ref = solve_batch(
+        jnp.asarray(boards), SPEC_9, max_iters=4096,
+        locked_candidates=True, waves=3,
+    )
+    assert int(stats["solved"]) == 11
+    assert int(stats["validations"]) == int(np.asarray(ref.validations).sum())
+    assert int(stats["guesses"]) == int(np.asarray(ref.guesses).sum())
+    # the PR 7 loop-work counters ride along (mesh-psum'd)
+    assert int(stats["lane_steps"]) > 0
+    assert int(stats["idle_lane_steps"]) >= 0
+
+
+def test_make_sharded_solver_carries_hotloop_config():
+    """The --solver-config flavor reaches the sharded path: legacy vs
+    default run different loops but produce identical answers."""
+    mesh = default_mesh()
+    boards = generate_batch(8, 50, seed=23)
+    g1, s1, st1 = make_sharded_solver(mesh)(boards)
+    g2, s2, st2 = make_sharded_solver(mesh, legacy_loop=True)(boards)
+    assert np.array_equal(np.asarray(g1), np.asarray(g2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+    # legacy's floor-64 ladder sweeps more finished lanes than the dense
+    # floor-16 default — the same counter inequality CI pins for the
+    # unsharded loop (perf-smoke)
+    assert int(st2["idle_lane_steps"]) >= int(st1["idle_lane_steps"])
+
+
+def test_mesh_aot_roundtrip_and_device_assignment_gate(tmp_path):
+    """A mesh engine bakes verified artifacts and a second engine serves
+    from them; the artifact key carries the mesh shape, so a DIFFERENT
+    topology never loads the exec tier (cross-topology loads happen via
+    the portable StableHLO tier or recompile — never a baked assignment)."""
+    d = str(tmp_path / "plane")
+    e1 = SolverEngine(
+        mesh="auto", buckets=(8,), coalesce=False, compile_cache_dir=d
+    )
+    e1.warmup()
+    src1 = {
+        k: v["source"] for k, v in e1.warm_info()["buckets"].items()
+    }
+    assert src1 == {"8": "compile+save"}
+    e1.close()
+
+    e2 = SolverEngine(
+        mesh="auto", buckets=(8,), coalesce=False, compile_cache_dir=d
+    )
+    e2.warmup()
+    wi = e2.warm_info()
+    assert all(
+        v["source"].startswith("aot:") for v in wi["buckets"].values()
+    ), wi["buckets"]
+    assert wi["aot"]["loaded"] >= 1
+    boards = generate_batch(8, 50, seed=5)
+    sols, mask, _ = e2.solve_batch_np(boards)
+    assert bool(mask.all())
+    assert oracle_is_valid_solution(sols[0].tolist())
+    e2.close()
+
+    # different topology (half-width mesh over the same store): the
+    # program key includes the mesh shape, so this engine compiles its
+    # own program rather than loading a full-mesh artifact
+    from jax.sharding import Mesh
+
+    e3 = SolverEngine(
+        mesh=Mesh(np.array(jax.devices()[: _ndev() // 2]), ("data",)),
+        buckets=(8,),
+        coalesce=False,
+        compile_cache_dir=d,
+    )
+    e3.warmup()
+    src3 = {k: v["source"] for k, v in e3.warm_info()["buckets"].items()}
+    assert src3 == {"8": "compile+save"}, src3
+    sols3, mask3, _ = e3.solve_batch_np(boards)
+    assert np.array_equal(sols, sols3)  # parity across topologies
+    e3.close()
+
+
+def test_supervised_mesh_engine_probe_and_fallback():
+    """The supervision seam threads through the sharded dispatch: a probe
+    round-trips the mesh program, and an injected failure still reroutes
+    to the (budgeted) host-oracle fallback."""
+    from sudoku_solver_distributed_tpu.serving.health import (
+        EngineSupervisor,
+        HEALTHY,
+    )
+    from sudoku_solver_distributed_tpu.utils.faults import (
+        EngineFaultInjector,
+    )
+
+    eng = SolverEngine(mesh="auto", buckets=(8,), coalesce=False)
+    sup = EngineSupervisor(
+        eng, watchdog_budget_s=5.0, probe_interval_s=0.05,
+        fallback_budget_s=10.0,
+    )
+    try:
+        eng.warmup()
+        assert sup.probe()
+        assert sup.state == HEALTHY
+        inj = EngineFaultInjector()
+        eng.fault_injector = inj
+        inj.arm_fail_next(1)
+        board = generate_batch(1, 40, seed=3)[0]
+        sol, info = eng.solve_one(board.tolist())
+        assert sol is not None and oracle_is_valid_solution(sol)
+        assert info.get("routed") == "oracle-fallback"
+        assert info.get("degraded")
+    finally:
+        sup.close()
+        eng.close()
+
+
+# -- fallback time budget (ISSUE 8 satellite: PR 5 known limit) -----------
+
+
+def test_oracle_budget_contract():
+    empty9 = [[0] * 9 for _ in range(9)]
+    assert oracle_solve(empty9, budget_s=30.0) is not None
+    with pytest.raises(OracleBudgetExceeded):
+        oracle_solve(empty9, budget_s=0.0)
+    # a 16x16 has >128 MRV steps, so the in-search check fires too
+    empty16 = [[0] * 16 for _ in range(16)]
+    with pytest.raises(OracleBudgetExceeded):
+        oracle_solve(empty16, budget_s=1e-9)
+    # unbudgeted callers (the whole test oracle surface) are unchanged
+    assert oracle_solve(empty16) is not None
+
+
+def test_fallback_budget_trips_and_counts():
+    from sudoku_solver_distributed_tpu.serving.health import EngineSupervisor
+
+    eng = SolverEngine(buckets=(1,), coalesce=False)
+    sup = EngineSupervisor(eng, fallback_budget_s=1e-9)
+    try:
+        with pytest.raises(OracleBudgetExceeded):
+            sup.fallback_solve(np.zeros((16, 16), np.int32))
+        assert sup.snapshot()["fallback"]["budget_trips"] == 1
+        assert sup.snapshot()["fallback"]["budget_s"] == 1e-9
+    finally:
+        sup.close()
+        eng.close()
+
+
+def test_verify_unsat_budget_trip_accepts_device_claim(readme_puzzle):
+    """An UNSAT cross-check that runs out of budget must accept the
+    device's claim (undetermined ≠ wrong), not 503 an answered request.
+    The README 8-clue board: deep enough that the MRV search passes the
+    budget checkpoint (an empty grid solves in under one check period)."""
+    from sudoku_solver_distributed_tpu.serving.health import EngineSupervisor
+
+    eng = SolverEngine(buckets=(1,), coalesce=False)
+    sup = EngineSupervisor(eng, fallback_budget_s=1e-9)
+    try:
+        alt, info = sup.verify_unsat(readme_puzzle)
+        assert alt is None and info == {}
+        assert sup.snapshot()["fallback"]["budget_trips"] == 1
+    finally:
+        sup.close()
+        eng.close()
+
+
+def test_degraded_over_budget_answers_503_over_http():
+    """End to end: a DEGRADED 16x16 node whose fallback budget is tiny
+    answers a clean 503 (X-Degraded) instead of pinning the worker on the
+    oracle's exponential tail — the PR 5 known limit, closed."""
+    from sudoku_solver_distributed_tpu.net import P2PNode, make_http_server
+    from sudoku_solver_distributed_tpu.serving.health import (
+        DEGRADED,
+        EngineSupervisor,
+    )
+    from sudoku_solver_distributed_tpu.utils.profiling import RequestMetrics
+
+    from test_net_node import free_port
+
+    eng = SolverEngine(
+        spec=spec_for_size(16), buckets=(1,), coalesce=False
+    )
+    sup = EngineSupervisor(
+        eng,
+        probe_interval_s=3600.0,  # no probe may heal it mid-test
+        fallback_budget_s=1e-9,
+    )
+    # force DEGRADED without touching the device
+    sup.record_failure(None, "error")
+    assert sup.state == DEGRADED
+    node = P2PNode(
+        "127.0.0.1", free_port(), engine=eng, metrics=RequestMetrics()
+    )
+    threading.Thread(target=node.run, daemon=True).start()
+    httpd = make_http_server(node, "127.0.0.1", free_port())
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/solve",
+            data=json.dumps(
+                {"sudoku": [[0] * 16 for _ in range(16)]}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=60)
+        elapsed = time.monotonic() - t0
+        assert exc.value.code == 503
+        assert exc.value.headers.get("X-Degraded") == "true"
+        body = json.loads(exc.value.read())
+        assert "budget" in body["error"]
+        assert elapsed < 30, "503 must be prompt, not an oracle tail"
+        assert sup.snapshot()["fallback"]["budget_trips"] >= 1
+    finally:
+        httpd.shutdown()
+        node.shutdown()
+        sup.close()
+        eng.close()
