@@ -33,12 +33,15 @@ Modes (BENCH_MODE env var):
     persistent-XLA-cache, AOT-artifact} on CPU (engine tiered warmup +
     compilecache/). Artifact benchmarks/coldstart_pr4.json; vs_baseline
     = warm-vs-cold first-solve speedup over the ≥3× acceptance bar.
-  obs-overhead — the tracing plane's cost proof (ISSUE 6): tracing-on vs
-    --no-obs aggregate puzzles/s under BENCH_OBS_CLIENTS (default 64)
-    closed-loop clients (acceptance: on ≥ 0.97× off), plus an injected
-    breaker-trip incident whose flight-recorder dump must carry the
-    poisoned request's span with per-stage timings. Artifact
-    benchmarks/obs_overhead_pr6.json.
+  obs-overhead — the observability planes' cost proof (ISSUE 6 + the
+    ISSUE 10 per-bucket cost accounting, which records per BATCH on the
+    serving path): tracing-on vs --no-obs aggregate puzzles/s under
+    BENCH_OBS_CLIENTS (default 64) closed-loop clients (acceptance: on ≥
+    0.97× off), plus an injected breaker-trip incident whose
+    flight-recorder dump must carry the poisoned request's span with
+    per-stage timings, and the traced node's live engine.cost block.
+    Artifact benchmarks/obs_overhead_pr10.json (PR 6's bound held with
+    cost accounting on; obs_overhead_pr6.json is the pre-cost baseline).
   hotloop — the solver hot-loop A/B (ISSUE 7): the PR 7 loop (dense
     prefix-gather compaction, one-hot merges, packed bitplane analysis)
     vs ``legacy_loop=True`` on the hard corpus, pinned core, paired
@@ -1589,8 +1592,12 @@ def main_obs_overhead():
     the black box demonstrably answers "what was the node doing when it
     went DEGRADED".
 
-    Artifact: benchmarks/obs_overhead_pr6.json (BENCH_OBS_OUT overrides).
-    Default platform cpu (same pooled-chip rule as farm/concurrent).
+    Artifact: benchmarks/obs_overhead_pr10.json (BENCH_OBS_OUT
+    overrides; obs_overhead_pr6.json is the frozen PR 6 baseline the
+    refreshed paired ratio is compared against — the ISSUE 10 cost
+    accounting records per batch on the same serving path and must not
+    regress the bound). Default platform cpu (same pooled-chip rule as
+    farm/concurrent).
     """
     import subprocess
     import tempfile
@@ -1608,7 +1615,7 @@ def main_obs_overhead():
     repo = os.path.dirname(os.path.abspath(__file__))
     out_path = os.environ.get(
         "BENCH_OBS_OUT",
-        os.path.join(repo, "benchmarks", "obs_overhead_pr6.json"),
+        os.path.join(repo, "benchmarks", "obs_overhead_pr10.json"),
     )
     base_port = 18400 + os.getpid() % 700
     PORT_ON, PORT_OFF = base_port, base_port + 2
@@ -1803,6 +1810,7 @@ def main_obs_overhead():
     cpu = {"off": [0.0, 0], "on": [0.0, 0]}  # cpu seconds, requests
     timing_sample = None
     obs_snapshot = None
+    cost_snapshot = None
     proc_on = boot_node(PORT_ON, PORT_ON - 1000, [])
     proc_off = boot_node(PORT_OFF, PORT_OFF - 1000, ["--no-obs"])
     arm_proc = {"on": proc_on, "off": proc_off}
@@ -1836,7 +1844,12 @@ def main_obs_overhead():
             timing_sample = json.loads(r.headers["X-Timing"])
             assert r.headers["X-Request-Id"] == "bench-obs-probe"
         _h, raw = scrape(PORT_ON, "/metrics")
-        obs_snapshot = json.loads(raw).get("obs", {})
+        metrics_body = json.loads(raw)
+        obs_snapshot = metrics_body.get("obs", {})
+        # the ISSUE 10 cost-accounting evidence from the driven node
+        # itself: per-bucket device-seconds / fill / lane utilization
+        # recorded on the SERVING path during the A/B windows
+        cost_snapshot = metrics_body.get("engine", {}).get("cost")
     finally:
         for c in conns.values():
             c.close()
@@ -1955,8 +1968,16 @@ def main_obs_overhead():
         ),
         "timing_header_sample": timing_sample,
         "obs_snapshot": obs_snapshot,
+        "engine_cost": cost_snapshot,
         "incident": incident,
     }
+    # the paired-ratio bound this refresh must hold: PR 6's committed
+    # artifact (tracing plane alone) — cost accounting rides the same
+    # serving path and records per batch, so the ratio must not regress
+    pr6_path = os.path.join(repo, "benchmarks", "obs_overhead_pr6.json")
+    if os.path.exists(pr6_path):
+        with open(pr6_path) as f:
+            record["pr6_value"] = json.load(f).get("value")
     with open(out_path, "w") as f:
         json.dump(record, f, indent=1)
         f.write("\n")

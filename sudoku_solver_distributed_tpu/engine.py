@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .obs.cost import CostAccounting
 from .obs.trace import current_trace
 from .ops import BoardSpec, SPEC_9, solve_batch
 from .ops import solver as _solver
@@ -479,6 +480,13 @@ class SolverEngine:
         # injected latency (watchdog food), bucket poisoning. None costs
         # nothing; counters surface under /metrics "faults".
         self.fault_injector = None
+        # Device cost accounting (ISSUE 10, obs/cost.py): every finalized
+        # bucket dispatch records wall time, batch fill, pad waste
+        # (coalescer vs mesh-rounding, split), and the PR 7 LoopStats
+        # lane/idle counters threaded out of the compiled program as two
+        # trailing packed-row columns. One locked append per BATCH —
+        # never per request — surfaced at /metrics engine.cost.
+        self.cost = CostAccounting()
         # Warm-state plane (ISSUE 4). `warmed` flips at TIER-0 warm — the
         # smallest serving bucket (+ the coalescer's preferred bucket and
         # the probe program) compiled, i.e. /solve is servable without
@@ -538,16 +546,17 @@ class SolverEngine:
                 # block is a lane width: always 128 on TPU (Mosaic tiling —
                 # the kernel pads small buckets up to a block multiple);
                 # interpret mode matches so both paths run the same shapes
-                res = solve_batch_pallas(
+                res, lstats = solve_batch_pallas(
                     grid,
                     self.spec,
                     block=128,
                     max_depth=self.max_depth,
                     max_iters=mi,
                     interpret=jax.default_backend() != "tpu",
+                    return_stats=True,
                 )
             else:
-                res = solve_batch(
+                res, lstats = solve_batch(
                     grid,
                     self.spec,
                     max_depth=self.max_depth,
@@ -555,12 +564,16 @@ class SolverEngine:
                     locked_candidates=self.locked_candidates,
                     waves=waves_eff,
                     naked_pairs=self.naked_pairs,
+                    return_stats=True,
                     **self.solver_overrides,
                 )
             # Pack every result field into ONE int32 array: the serving path
             # pays exactly one device→host transfer per request. (Unpacked,
             # each field is its own transfer — at ~70 ms RTT over a tunneled
-            # TPU that quadruples request latency.)
+            # TPU that quadruples request latency.) The two trailing
+            # columns carry the call's LoopStats scalars broadcast across
+            # rows (lane_steps / idle_lane_steps — obs/cost.py reads row 0)
+            # so the loop-work counters ride the SAME single transfer.
             return jnp.concatenate(
                 [
                     res.grid.reshape(B, -1),
@@ -568,6 +581,8 @@ class SolverEngine:
                     res.status[:, None],
                     res.guesses[:, None],
                     res.validations[:, None],
+                    jnp.broadcast_to(lstats.lane_steps, (B,))[:, None],
+                    jnp.broadcast_to(lstats.idle_lane_steps, (B,))[:, None],
                 ],
                 axis=1,
             )
@@ -764,6 +779,11 @@ class SolverEngine:
             "fully_warmed": self.fully_warmed,
             "warm": self.warm_info(),
         }
+        # the device cost-accounting block (ISSUE 10, obs/cost.py):
+        # per-bucket device-seconds / pps / fill / pad-waste split /
+        # lane utilization, plus compile amortization against the warm
+        # plane's recorded compile costs — /metrics "engine.cost"
+        out["cost"] = self.cost.snapshot(warm_info=out["warm"])
         mesh = self.mesh_info()
         if mesh is not None:
             # the mesh-serving plane (ISSUE 8): topology + batch-split
@@ -921,6 +941,9 @@ class SolverEngine:
 
     def _dispatch_padded_inner(self, boards: np.ndarray, bucket: int):
         n = boards.shape[0]
+        # dispatch wall-clock anchor: rides the handle so _finalize_padded
+        # can bill the whole dispatch→fetch span to obs/cost.py
+        t0 = time.monotonic()
         inj = self.fault_injector
         if inj is not None:
             inj.on_device_call(bucket)  # may raise (fail-next-N)
@@ -946,7 +969,7 @@ class SolverEngine:
             packed = self.mesh_runner(boards, int(self.max_iters))
             with self._lock:
                 self.mesh_runner_dispatches += 1
-            return packed, boards, n
+            return packed, boards, n, t0
         if (
             self._device_trace_budget > 0
             and self.device_trace_dir is not None
@@ -1000,22 +1023,27 @@ class SolverEngine:
                     or ndev < self._mesh_min_devices
                 ):
                     self._mesh_min_devices = ndev
-        return packed, boards, n
+        return packed, boards, n, t0
 
     def _finalize_padded(
-        self, packed, boards: np.ndarray, n: int, token=None
+        self, packed, boards: np.ndarray, n: int, t0=None, token=None
     ) -> np.ndarray:
         """Fetch an in-flight ``_dispatch_padded`` call (blocks on the
         device) and run the deep-retry safety net on any capped rows.
-        ``token`` is the supervision token the dispatch opened (rides the
-        opaque handle; closed here however the fetch ends).
+        ``t0`` is the dispatch's monotonic anchor (the cost-accounting
+        span start) and ``token`` the supervision token the dispatch
+        opened — both ride the opaque handle; the token closes here
+        however the fetch ends.
 
-        Returns the packed (n, C+4) host array: [grid | solved | status |
-        guesses | validations] per row.
+        Returns the packed (n, C+6) host array: [grid | solved | status |
+        guesses | validations | lane_steps | idle_lane_steps] per row
+        (the two trailing columns are per-CALL LoopStats scalars
+        broadcast across rows — obs/cost.py evidence, sliced off by
+        every result reader).
         """
         sup = self.supervisor
         try:
-            rows = self._finalize_padded_inner(packed, boards, n)
+            rows = self._finalize_padded_inner(packed, boards, n, t0)
         except BaseException:
             if sup is not None:
                 sup.call_finished(token, ok=False)
@@ -1024,8 +1052,40 @@ class SolverEngine:
             sup.call_finished(token, ok=True)
         return rows
 
+    def _record_call_cost(
+        self, bucket: int, n: int, device_s, lane: int, idle: int,
+        deep_retry: bool = False,
+    ) -> None:
+        """Fold one finalized device call into obs/cost.py, splitting the
+        pad waste between the coalescer (rows short of the REQUESTED
+        ladder width) and the mesh rounding (the extra width ISSUE 8's
+        mesh-divisible ladder added on top)."""
+        pad_total = bucket - n
+        req_cover = next(
+            (w for w in self.requested_buckets if w >= n), None
+        )
+        if req_cover is not None and req_cover <= bucket:
+            pad_coalesce = req_cover - n
+            pad_mesh = bucket - req_cover
+        else:
+            # the mesh-rounded width is NARROWER than any requested cover
+            # (or n overflows the ladder): the rounding saved pad rows
+            # rather than adding them — bill everything to the coalescer
+            pad_coalesce = pad_total
+            pad_mesh = 0
+        self.cost.record_call(
+            bucket=bucket,
+            boards=n,
+            pad_coalesce=pad_coalesce,
+            pad_mesh=pad_mesh,
+            device_s=device_s if device_s is not None else 0.0,
+            lane_steps=lane,
+            idle_lane_steps=idle,
+            deep_retry=deep_retry,
+        )
+
     def _finalize_padded_inner(
-        self, packed, boards: np.ndarray, n: int
+        self, packed, boards: np.ndarray, n: int, t0=None
     ) -> np.ndarray:
         inj = self.fault_injector
         if inj is not None:
@@ -1040,6 +1100,17 @@ class SolverEngine:
         if inj is not None:
             packed = inj.corrupt(boards.shape[0], packed)
         C = self.spec.cells
+        # cost accounting (obs/cost.py), BEFORE the deep-retry merge can
+        # overwrite the trailing LoopStats columns of capped rows: the
+        # whole dispatch→fetch wall, the real fill, and this call's
+        # lane/idle counters (broadcast scalars — row 0 is the call's)
+        self._record_call_cost(
+            boards.shape[0],
+            n,
+            None if t0 is None else time.monotonic() - t0,
+            int(packed[0, C + 4]) if packed.shape[1] > C + 4 else 0,
+            int(packed[0, C + 5]) if packed.shape[1] > C + 5 else 0,
+        )
         running = packed[:, C + 1] == RUNNING
         # trigger on REAL rows only: a deep pass for discarded pad lanes is
         # pure waste (the merge below may still overwrite pad rows — they
@@ -1070,6 +1141,7 @@ class SolverEngine:
                     ],
                     axis=0,
                 )
+            t_deep = time.monotonic()
             if self.mesh_runner is not None:
                 # the deep retry is a collective too: it must ride the
                 # loop like the first pass, or the leader would enter a
@@ -1085,6 +1157,15 @@ class SolverEngine:
                         self._solve_deep(self._device_batch(sub))
                     )
                 )
+            # the deep retry is its own device call — its own cost sample
+            self._record_call_cost(
+                sub.shape[0],
+                len(capped),
+                time.monotonic() - t_deep,
+                int(deep[0, C + 4]) if deep.shape[1] > C + 4 else 0,
+                int(deep[0, C + 5]) if deep.shape[1] > C + 5 else 0,
+                deep_retry=True,
+            )
             first = packed[capped].copy()
             packed[capped] = deep[: len(capped)]
             packed[capped, C + 2] += first[:, C + 2]
@@ -1301,6 +1382,10 @@ class SolverEngine:
             "locked_candidates": self.locked_candidates,
             "waves": self.waves,
             "naked_pairs": self.naked_pairs,
+            # packed-row format version: v2 = two trailing LoopStats
+            # columns (ISSUE 10 cost accounting) — keys a clean artifact
+            # break instead of a load-then-fail-shape-verify round trip
+            "row_format": "v2-lanestats",
         }
         if self.backend == "xla":
             # the RESOLVED hot-loop shape (ladder, period, packing, legacy
@@ -1444,7 +1529,9 @@ class SolverEngine:
         except Exception:  # noqa: BLE001 — a crashing artifact is invalid
             logger.exception("AOT artifact (width %d) failed to run", b)
             return False
-        if packed.shape != (b, C + 4):
+        if packed.shape != (b, C + 6):
+            # C+6 since ISSUE 10 (two trailing LoopStats columns) — a
+            # pre-cost-plane artifact fails here and recompiles cleanly
             return False
         row = packed[0]
         if int(row[C + 1]) != SOLVED or not int(row[C]):
@@ -1684,12 +1771,22 @@ class SolverEngine:
             boards = np.concatenate(
                 [boards, np.broadcast_to(arr, (bucket - 1, *arr.shape))]
             )
-        # explicit sync at the probe's documented fetch point (JAX101)
-        packed = np.asarray(
-            jax.block_until_ready(
-                self._solve_quick(self._device_batch(boards))
+        # explicit sync at the probe's documented fetch point (JAX101);
+        # the probe IS device work — stamped on the request span so an
+        # auto-routed /solve answers a non-zero X-Timing device field
+        # whether the probe answered it or the race did (ISSUE 10
+        # satellite: frontier-route span completeness)
+        tr = current_trace()
+        t_dev = time.monotonic()
+        try:
+            packed = np.asarray(
+                jax.block_until_ready(
+                    self._solve_quick(self._device_batch(boards))
+                )
             )
-        )
+        finally:
+            if tr is not None:
+                tr.mark("device", time.monotonic() - t_dev)
         C = self.spec.cells
         row = packed[0]
         status = int(row[C + 1])
@@ -1732,10 +1829,17 @@ class SolverEngine:
         # unpadded for the stack decomposition, so bypass the sharding (the
         # probe is a single-board program either way; code-review r4)
         self._note_program("quick_state", 1)
-        packed_dev, st = self._solve_quick_state(jnp.asarray(arr[None]))
-        # ONE transfer on the common path, explicit (JAX101); st stays
-        # device-resident unless the request escalates
-        packed = np.asarray(jax.block_until_ready(packed_dev))
+        tr = current_trace()
+        t_dev = time.monotonic()
+        try:
+            packed_dev, st = self._solve_quick_state(jnp.asarray(arr[None]))
+            # ONE transfer on the common path, explicit (JAX101); st stays
+            # device-resident unless the request escalates
+            packed = np.asarray(jax.block_until_ready(packed_dev))
+        finally:
+            if tr is not None:
+                # same span-completeness contract as _probe_quick
+                tr.mark("device", time.monotonic() - t_dev)
         C = self.spec.cells
         status = int(packed[C])
         validations = int(packed[C + 2])
@@ -1770,7 +1874,16 @@ class SolverEngine:
         """Run the race without serving-stats side effects; _frontier_solve
         wraps it with the counter accounting."""
         if self.frontier_runner is not None:
-            solution, info = self.frontier_runner(arr)
+            # multi-host race: the loop's round-trip IS this request's
+            # device stage (the local branch is stamped finer inside
+            # frontier_solve — seeding as coalesce, the race as device)
+            tr = current_trace()
+            t_dev = time.monotonic()
+            try:
+                solution, info = self.frontier_runner(arr)
+            finally:
+                if tr is not None:
+                    tr.mark("device", time.monotonic() - t_dev)
         else:
             from .parallel import frontier_solve
 

@@ -79,6 +79,17 @@ Extensions (defaults preserve reference behavior):
                 the plane costs ~15 µs/request (bench.py --mode
                 obs-overhead holds the throughput A/B) and is the node's
                 black box
+  --slo / --slo-fast-burn
+                declarative latency objectives (obs/slo.py, repeatable:
+                --slo latency_p99_ms=500@99.9) evaluated as 5m/1h burn
+                rates from the stage histograms; the 'slo' /metrics
+                block + prom gauges carry them, and a fast-burn crossing
+                (both windows over the bar) triggers the incident
+                flight-recorder dump — alert-triggered, not just
+                crash-triggered. With --metrics, GET /metrics/cluster
+                (+ .prom) renders the gossip-aggregated fleet view
+                (obs/cluster.py) and GET /debug/trace exports the span
+                ring as Perfetto-loadable trace-event JSON (obs/export.py)
   --flightrecord-dir
                 where incident flight-recorder dumps land (breaker trip,
                 shed storm, SIGUSR2, POST /debug/flightrecord); env default
@@ -321,6 +332,28 @@ def build_parser() -> argparse.ArgumentParser:
         "stage histograms, and the incident flight recorder (X-Request-Id "
         "echo stays — ids correlate retries regardless). On by default "
         "(bench.py --mode obs-overhead holds the cost claim)",
+    )
+    parser.add_argument(
+        "--slo",
+        action="append",
+        default=[],
+        metavar="NAME=MS@PCT",
+        help="declarative latency objective, repeatable (obs/slo.py): "
+        "e.g. --slo latency_p99_ms=500@99.9 means 99.9%% of requests "
+        "under 500 ms; [stage_] prefixes pick a span stage "
+        "(device_latency_p99_ms=50@99). Evaluated as 5m/1h burn rates "
+        "from the stage histograms, exposed as an 'slo' /metrics block "
+        "+ prom gauges; a fast-burn crossing (both windows over 14.4x "
+        "budget rate) records a flight-recorder event and triggers the "
+        "incident auto-dump. Requires the tracing plane (not --no-obs)",
+    )
+    parser.add_argument(
+        "--slo-fast-burn",
+        type=float,
+        default=14.4,
+        help="with --slo: the fast-burn page bar in multiples of the "
+        "sustainable budget-spend rate (default 14.4 — the classic "
+        "2%%-of-monthly-budget-in-an-hour alert)",
     )
     parser.add_argument(
         "--flightrecord-dir",
@@ -582,11 +615,30 @@ def main(argv=None) -> None:
     # unconditional on both arms — retries must correlate regardless).
     tracer = None
     flight = None
+    slo = None
     if not args.no_obs:
         from ..obs import FlightRecorder, Tracer
 
         flight = FlightRecorder(dump_dir=args.flightrecord_dir)
         tracer = Tracer(recorder=flight)
+        if args.slo:
+            # SLO burn-rate engine (ISSUE 10, obs/slo.py): objectives
+            # parse at startup (a malformed spec must fail the boot, not
+            # the claim window), evaluation rides Tracer.finish
+            from ..obs.slo import SloEngine, parse_slo
+
+            slo = SloEngine(
+                tracer.stages,
+                [parse_slo(s) for s in args.slo],
+                recorder=flight,
+                fast_burn_threshold=args.slo_fast_burn,
+            )
+            tracer.slo = slo
+    elif args.slo:
+        raise SystemExit(
+            "--slo needs the tracing plane (stage histograms) — "
+            "remove --no-obs"
+        )
 
     admission = None
     if args.admission_capacity > 0 or args.default_deadline_ms > 0:
@@ -634,6 +686,14 @@ def main(argv=None) -> None:
     )
     node.tracer = tracer
     node.flight = flight
+    node.slo = slo
+    if tracer is not None:
+        # fleet telemetry publisher (ISSUE 10, obs/cluster.py): this
+        # node's digest rides every stats-gossip heartbeat (rebuilt at
+        # most 1/s) so any peer can render GET /metrics/cluster
+        from ..obs.cluster import TelemetryPublisher
+
+        node.telemetry = TelemetryPublisher(node)
     if flight is not None:
         import signal
 

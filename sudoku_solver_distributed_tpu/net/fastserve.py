@@ -413,6 +413,20 @@ class FastHTTPServer:
                 # Prometheus exposition — the shared core renders it, so
                 # the bytes match the stock transport's exactly
                 return 200, http_api.metrics_prom_payload(node), False, False
+            if path == http_api.CLUSTER_PATH and self.expose_metrics:
+                # the gossip-aggregated fleet view (ISSUE 10)
+                return 200, http_api.cluster_payload(node), False, False
+            if path in http_api.CLUSTER_PROM_PATHS and self.expose_metrics:
+                return (
+                    200, http_api.cluster_prom_payload(node), False, False,
+                )
+            if (
+                path == "/debug/trace"
+                and getattr(node, "flight", None) is not None
+            ):
+                # the span ring as Perfetto-loadable trace-event JSON
+                status, payload, _error = http_api.trace_export_route(node)
+                return status, payload, False, False
             if path == "/healthz":
                 return 200, http_api.healthz_payload(node), False, False
             if path == "/readyz":

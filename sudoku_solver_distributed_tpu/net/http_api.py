@@ -403,6 +403,12 @@ def metrics_payload(p2p_node):
     flight = getattr(p2p_node, "flight", None)
     if flight is not None:
         body.setdefault("obs", {})["flight"] = flight.stats()
+    # the SLO burn-rate engine (obs/slo.py, ISSUE 10): per-objective
+    # multi-window burn rates + fast-burn gauges; a scrape gets a fresh
+    # evaluation (tick is rate-limited internally)
+    slo = getattr(p2p_node, "slo", None)
+    if slo is not None:
+        body["slo"] = slo.snapshot()
     return body
 
 
@@ -410,6 +416,46 @@ def metrics_payload(p2p_node):
 # (no general query parsing: every other route's unknown-path 404 surface
 # stays byte-identical to the reference)
 PROM_PATHS = ("/metrics.prom", "/metrics?format=prom")
+
+# the cluster view's spellings (ISSUE 10), same exact-match contract
+CLUSTER_PATH = "/metrics/cluster"
+CLUSTER_PROM_PATHS = (
+    "/metrics/cluster.prom",
+    "/metrics/cluster?format=prom",
+)
+
+
+def cluster_payload(p2p_node) -> dict:
+    """``GET /metrics/cluster``: the gossip-aggregated fleet view — this
+    node's own telemetry digest, every unexpired peer digest (TTL'd,
+    freshness-marked), and fleet rollups (obs/cluster.py). Served by both
+    transports through this one core, gated like /metrics."""
+    from ..obs.cluster import cluster_snapshot
+
+    return cluster_snapshot(p2p_node)
+
+
+def cluster_prom_payload(p2p_node) -> bytes:
+    """The Prometheus rendering of the SAME cluster snapshot: per-node
+    gauges labeled ``{node="host:port"}`` plus flattened fleet rollups —
+    one scrape config covers the whole fleet through any member."""
+    from ..obs.cluster import cluster_snapshot, render_cluster_prom
+
+    return render_cluster_prom(cluster_snapshot(p2p_node)).encode()
+
+
+def trace_export_route(p2p_node):
+    """``GET /debug/trace``: the flight-recorder span ring assembled as
+    Chrome trace-event JSON (obs/export.py — Perfetto-loadable), request
+    spans and wire-propagated farm-task spans in one tree. Returns
+    (status, payload, error); 404 on nodes without a recorder, exactly
+    like /debug/flightrecord."""
+    flight = getattr(p2p_node, "flight", None)
+    if flight is None:
+        return 404, {"error": "Invalid endpoint"}, True
+    from ..obs.export import build_trace
+
+    return 200, build_trace(flight.spans()), False
 
 
 def metrics_prom_payload(p2p_node) -> bytes:
@@ -639,6 +685,18 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             # the Prometheus exposition of the same body (shared core —
             # byte-identical on both transports)
             self._send_response(metrics_prom_payload(self.p2p_node))
+        elif self.path == CLUSTER_PATH and self.expose_metrics:
+            # the gossip-aggregated fleet view (ISSUE 10)
+            self._send_response(cluster_payload(self.p2p_node))
+        elif self.path in CLUSTER_PROM_PATHS and self.expose_metrics:
+            self._send_response(cluster_prom_payload(self.p2p_node))
+        elif (
+            self.path == "/debug/trace"
+            and getattr(self.p2p_node, "flight", None) is not None
+        ):
+            # the span ring as Perfetto-loadable trace-event JSON
+            status, payload, _error = trace_export_route(self.p2p_node)
+            self._send_response(payload, status)
         elif self.path == "/healthz":
             self._send_response(healthz_payload(self.p2p_node))
         elif self.path == "/readyz":

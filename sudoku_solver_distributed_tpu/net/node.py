@@ -33,7 +33,7 @@ from ..obs.trace import current_trace, valid_request_id
 from ..utils import HandicapLimiter
 from . import wire
 from .membership import Membership
-from .stats import PeerHealth, StatsGossip
+from .stats import PeerHealth, PeerTelemetry, StatsGossip
 
 logger = logging.getLogger(__name__)
 
@@ -91,6 +91,16 @@ class P2PNode:
         # they still answer, but from a host-oracle fallback while an
         # engine rebuild runs, and a farmed cell should not wait on that
         self.peer_health = PeerHealth()
+        # peers' fleet-observability digests, piggybacked the same way
+        # (wire.stats_msg "telemetry", ISSUE 10): TTL'd, bounded,
+        # sanitized at ingress — the /metrics/cluster data plane
+        self.peer_telemetry = PeerTelemetry()
+        # this node's own digest publisher (obs/cluster.TelemetryPublisher,
+        # wired by the CLI when the tracing plane is on): None — bare
+        # library nodes — gossips reference-identical stats bytes
+        self.telemetry = None
+        # SLO burn-rate engine (obs/slo.py, CLI --slo); None costs nothing
+        self.slo = None
 
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.shutdown_flag = False
@@ -233,12 +243,19 @@ class P2PNode:
             return
         snap = self.stats.snapshot()
         sup = getattr(self.engine, "supervisor", None)
+        # the telemetry digest rides every stats heartbeat but is rebuilt
+        # at most once per second (TelemetryPublisher cache) — this runs
+        # once per /solve on the serving path
+        telemetry = (
+            self.telemetry.digest() if self.telemetry is not None else None
+        )
         msg = wire.stats_msg(
             self.id,
             self._solved_count,
             self.engine.validations,
             snap,
             health=sup.state if sup is not None else None,
+            telemetry=telemetry,
         )
         for peer in peers:
             self.send_to(peer, msg)
@@ -363,6 +380,10 @@ class P2PNode:
             # reference traffic and supervisor-less nodes); PeerHealth
             # validates at the boundary like every other wire field
             self.peer_health.note(msg["origin"], msg.get("health"))
+            # fleet-telemetry piggyback (optional key, ISSUE 10):
+            # PeerTelemetry sanitizes at the boundary — hostile digests
+            # are dropped whole, never partially folded
+            self.peer_telemetry.note(msg["origin"], msg.get("telemetry"))
 
         elif mtype == "disconnect":
             if msg["address"] == self.id:
@@ -432,11 +453,12 @@ class P2PNode:
                         address,
                     )
                     return
-        # a departed peer's health claim dies with it (a rejoin at the
-        # same address starts with a clean slate); unconditional — a
-        # goodbye is authoritative about the peer whether or not it
-        # changed OUR membership view
+        # a departed peer's health claim — and its telemetry digest —
+        # die with it (a rejoin at the same address starts with a clean
+        # slate); unconditional — a goodbye is authoritative about the
+        # peer whether or not it changed OUR membership view
         self.peer_health.forget(address)
+        self.peer_telemetry.forget(address)
         changed, redial = self.membership.on_disconnect(address)
         if changed:
             if self.membership.all_peers:
@@ -737,9 +759,17 @@ class P2PNode:
                         )
                     )
 
-                # fold in any arrived solutions
+                # fold in any arrived solutions — the master's MERGE
+                # step: each answer is placement-checked against the
+                # merged board before it lands. Billed to the request
+                # span's verify stage below (ISSUE 10 satellite: the
+                # farm route used to be span-incomplete — device/verify
+                # fields empty on farmed requests)
+                t_fold = time.monotonic()
+                folded = 0
                 requeued_none = False
                 while self.solution_queue:
+                    folded += 1
                     row, col, value, peer = self.solution_queue.popleft()
                     # Retire the peer's assignment only if this answer is
                     # for it: a duplicated or deadline-late datagram about
@@ -760,12 +790,17 @@ class P2PNode:
                     else:
                         self.task_queue.appendleft((row, col))
 
+                fold_s = time.monotonic() - t_fold
                 done = not self.task_queue and not self.active_tasks
                 if not done and not to_send:
                     # with dispatches planned, skip the wait this round:
                     # the sends below must not sit on a held lock, and the
                     # next iteration (nothing new to send) waits as before
                     self._solution_event.wait(timeout=SOLVE_WAIT_SLICE_S)
+
+            if folded and req_trace is not None:
+                # merge-step verify time, stamped outside the lock
+                req_trace.mark("verify", fold_s)
 
             for peer, msg in to_send:
                 self.send_to(peer, msg)

@@ -206,6 +206,106 @@ class PeerHealth:
             return {p: s for p, (s, _) in self._states.items()}
 
 
+class PeerTelemetry:
+    """Last-known fleet-observability digest per peer, carried by the
+    ``telemetry`` piggyback on stats gossip (wire.stats_msg, ISSUE 10) —
+    the generalization of :class:`PeerHealth` from one enum to the whole
+    per-node digest (goodput, stage latencies, shed rate, warm fraction,
+    supervisor state, mesh topology; obs/cluster.py builds it).
+
+    Same evidence-not-membership contract as PeerHealth: entries EXPIRE
+    (``ttl_s``) so a stale digest can never render as live fleet state,
+    departures forget the peer entirely (net/node.py prunes on
+    disconnect/goodbye), and the map is bounded (``MAX_ENTRIES``) with
+    ingress sanitization so a hostile datagram can neither grow the heap
+    nor smuggle arbitrary structure onto the /metrics/cluster surface.
+    """
+
+    MAX_ENTRIES = 256        # flood bound, same rationale as PeerHealth
+    MAX_KEYS = 32            # digest keys accepted per peer
+    MAX_STR = 64             # digest string-value length cap
+
+    def __init__(self, ttl_s: float = 15.0):
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        # peer -> (sanitized digest dict, monotonic receive time)
+        self._digests: Dict[str, tuple] = {}
+
+    @classmethod
+    def sanitize(cls, raw) -> Optional[dict]:
+        """Boundary validation: a digest is a flat dict of short string
+        keys to scalars (numbers / bools / short strings / None).
+        Anything else — nested structure, huge blobs, non-dict garbage —
+        is rejected whole; partial acceptance would let one valid key
+        carry a payload of junk siblings onto the operator surface."""
+        if not isinstance(raw, dict) or len(raw) > cls.MAX_KEYS:
+            return None
+        out = {}
+        for k, v in raw.items():
+            if not isinstance(k, str) or not 0 < len(k) <= cls.MAX_STR:
+                return None
+            if isinstance(v, bool) or v is None:
+                out[k] = v
+            elif isinstance(v, (int, float)):
+                # NaN/inf survive JSON round-trips as valid floats but
+                # poison downstream min/max rollups — normalize to None
+                out[k] = v if v == v and abs(v) != float("inf") else None
+            elif isinstance(v, str) and len(v) <= cls.MAX_STR:
+                out[k] = v
+            else:
+                return None
+        return out
+
+    def note(self, peer: str, raw) -> None:
+        """Fold one gossip-carried digest; invalid payloads are dropped
+        at the boundary (same ingress rule as every other wire field)."""
+        digest = self.sanitize(raw)
+        if digest is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._digests[peer] = (digest, now)
+            if len(self._digests) > self.MAX_ENTRIES:
+                for p in [
+                    p
+                    for p, (_, t) in self._digests.items()
+                    if now - t > self.ttl_s
+                ]:
+                    del self._digests[p]
+            while len(self._digests) > self.MAX_ENTRIES:
+                oldest = min(
+                    self._digests.items(), key=lambda kv: kv[1][1]
+                )
+                del self._digests[oldest[0]]
+
+    def forget(self, peer: str) -> None:
+        """Departed peers carry no telemetry (rejoiners start fresh)."""
+        with self._lock:
+            self._digests.pop(peer, None)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Unexpired digests with their age:
+        {peer: {"age_s": float, "fresh": bool, **digest}} — ``fresh``
+        marks entries younger than half the TTL (the /metrics/cluster
+        freshness column)."""
+        now = time.monotonic()
+        with self._lock:
+            for peer in [
+                p
+                for p, (_, t) in self._digests.items()
+                if now - t > self.ttl_s
+            ]:
+                del self._digests[peer]
+            return {
+                p: {
+                    "age_s": round(now - t, 3),
+                    "fresh": (now - t) <= self.ttl_s / 2,
+                    **d,
+                }
+                for p, (d, t) in self._digests.items()
+            }
+
+
 def serving_snapshot(engine) -> Msg:
     """The opt-in ``serving`` block of GET /stats (CLI ``--serving-stats``).
 

@@ -19,10 +19,13 @@ node's traffic is indistinguishable from the reference's:
               byte-identical, same trailing-optional pattern as
               disconnect's row/col and stats' health)
   stats       {"type", "origin", "solved", "stats": {"address", "validations"},
-               "all_stats"[, "health"]}                reference node.py:583-592
+               "all_stats"[, "health"][, "telemetry"]} reference node.py:583-592
               ("health" is this stack's optional supervisor-state
-              piggyback — absent unless an EngineSupervisor is attached,
-              keeping default traffic byte-identical)
+              piggyback — absent unless an EngineSupervisor is attached;
+              "telemetry" is the optional fleet-observability digest
+              (obs/cluster.py, ISSUE 10) — absent unless the tracing
+              plane publishes one; both trailing, keeping default
+              traffic byte-identical)
 """
 
 from __future__ import annotations
@@ -222,13 +225,36 @@ def stats_msg(
     validations: int,
     all_stats: Msg,
     health: Optional[str] = None,
+    telemetry: Optional[Msg] = None,
 ) -> Msg:
     # ``health`` piggybacks the sender's engine-supervisor state
     # (serving/health.py: "warming"/"healthy"/"degraded"/"lost") on the
     # existing 1 Hz stats heartbeat so masters can skip LOST peers when
-    # farming tasks (net/node.py). Optional-and-trailing like
-    # disconnect's row/col: absent when no supervisor is attached, so
-    # the default wire bytes stay identical to the reference's.
+    # farming tasks (net/node.py). ``telemetry`` piggybacks the sender's
+    # fleet-observability digest (obs/cluster.py: goodput, stage
+    # latencies, shed rate, warm fraction, mesh topology — ISSUE 10) on
+    # the same heartbeat so any node can render GET /metrics/cluster.
+    # Both optional-and-trailing like disconnect's row/col — absent keys
+    # keep the default wire bytes identical to the reference's, and the
+    # four explicit literals keep every variant visible to
+    # analysis/wire_schema.py (a mutated dict would hide the schema).
+    if health is None and telemetry is None:
+        return {
+            "type": "stats",
+            "origin": origin,
+            "solved": solved,
+            "stats": {"address": origin, "validations": validations},
+            "all_stats": all_stats,
+        }
+    if telemetry is None:
+        return {
+            "type": "stats",
+            "origin": origin,
+            "solved": solved,
+            "stats": {"address": origin, "validations": validations},
+            "all_stats": all_stats,
+            "health": health,
+        }
     if health is None:
         return {
             "type": "stats",
@@ -236,6 +262,7 @@ def stats_msg(
             "solved": solved,
             "stats": {"address": origin, "validations": validations},
             "all_stats": all_stats,
+            "telemetry": telemetry,
         }
     return {
         "type": "stats",
@@ -244,4 +271,5 @@ def stats_msg(
         "stats": {"address": origin, "validations": validations},
         "all_stats": all_stats,
         "health": health,
+        "telemetry": telemetry,
     }

@@ -1,4 +1,4 @@
-"""Request-lifecycle observability plane (ISSUE 6).
+"""Observability plane: request lifecycle (ISSUE 6) + fleet (ISSUE 10).
 
 Spans across admission → coalesce → device → verify (obs/trace.py),
 one latency-recording machinery for routes and stages (obs/histo.py),
@@ -6,10 +6,18 @@ an always-on incident flight recorder (obs/flight.py), and Prometheus
 text exposition for the /metrics surface (obs/prom.py). Default-on in
 the serving CLI (net/cli.py ``--no-obs`` disables); a node built without
 a Tracer attached serves byte-identically to the PR 5 stack.
+
+The fleet layer (ISSUE 10): per-bucket device cost accounting
+(obs/cost.py → /metrics ``engine.cost``), gossip-aggregated cluster
+telemetry (obs/cluster.py → ``GET /metrics/cluster``), the SLO
+burn-rate engine (obs/slo.py, CLI ``--slo``), and Perfetto trace export
+(obs/export.py → ``GET /debug/trace`` + flight-dump embedding).
 """
 
+from .cost import CostAccounting
 from .flight import FlightRecorder
 from .histo import Histogram, LatencyWindow, RouteMetrics, StageMetrics
+from .slo import SloEngine, SloObjective, parse_slo
 from .trace import (
     STAGES,
     RequestTrace,
@@ -20,15 +28,19 @@ from .trace import (
 )
 
 __all__ = [
+    "CostAccounting",
     "FlightRecorder",
     "Histogram",
     "LatencyWindow",
     "RouteMetrics",
+    "SloEngine",
+    "SloObjective",
     "StageMetrics",
     "STAGES",
     "RequestTrace",
     "Tracer",
     "current_trace",
     "new_request_id",
+    "parse_slo",
     "valid_request_id",
 ]

@@ -1,0 +1,207 @@
+"""Per-bucket device cost accounting: where device time actually goes.
+
+The serving-path half of the fleet observability plane (ISSUE 10
+tentpole 1). Every bucket dispatch the engine finalizes — coalesced,
+direct, deep-retry, mesh or single-device — records ONE sample here:
+
+  device_s        dispatch → fetched-host-rows wall time (the same span
+                  the request tracer stamps as the ``device`` stage)
+  boards          real boards in the call (batch fill)
+  pad_coalesce    pad rows added to reach the *requested* bucket ladder
+                  width (the coalescer fed fewer boards than the bucket)
+  pad_mesh        pad rows the MESH ROUNDING added on top (ISSUE 8 widened
+                  the ladder to mesh-divisible multiples; that waste is
+                  the mesh plane's bill, not the coalescer's — the two are
+                  reported separately so each layer owns its own overhead)
+  lane_steps /    the PR 7 ``LoopStats`` loop-work counters, threaded out
+  idle_lane_steps of the compiled program as two trailing packed-row
+                  columns (engine._run ``return_stats=True``): lane
+                  utilization = 1 − idle/lane is the machine-independent
+                  "how much of the lockstep loop was real work" number the
+                  hotloop bench proved — now read from the SERVING path
+                  itself, not a bench harness.
+
+Recording is PER BATCH, not per request (one locked append per device
+call — the coalescer already amortizes requests into batches, so the
+plane's cost scales with device calls, which the obs-overhead bench
+bounds). ``snapshot()`` renders the ``engine.cost`` block of
+``GET /metrics``: cumulative totals, a rolling recent window (pps as the
+operator sees it now, not since boot), per-bucket breakdowns, and — when
+the engine passes its warm state — compile amortization: cumulative
+device-seconds served per compile-second paid (the ISSUE 4 plane's
+payoff as a live ratio).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+
+def _pct(part: float, whole: float) -> float:
+    return round(100.0 * part / whole, 2) if whole else 0.0
+
+
+class _BucketCost:
+    """Cumulative counters + a bounded recent-sample ring for one width."""
+
+    __slots__ = (
+        "dispatches", "boards", "pad_coalesce", "pad_mesh", "device_s",
+        "lane_steps", "idle_lane_steps", "deep_retries", "recent",
+    )
+
+    def __init__(self, window: int):
+        self.dispatches = 0
+        self.boards = 0
+        self.pad_coalesce = 0
+        self.pad_mesh = 0
+        self.device_s = 0.0
+        self.lane_steps = 0
+        self.idle_lane_steps = 0
+        self.deep_retries = 0
+        # (monotonic t, device_s, boards) — the recent-throughput window
+        self.recent: deque = deque(maxlen=window)
+
+
+class CostAccounting:
+    """Per-bucket rolling device-cost recorder (the ``engine.cost`` block).
+
+    Args:
+      window: recent-sample ring depth per bucket (throughput "now").
+      recent_horizon_s: samples older than this are ignored by the
+        recent-pps computation even if still in the ring — a burst an
+        hour ago must not read as current throughput.
+    """
+
+    def __init__(self, window: int = 256, recent_horizon_s: float = 60.0):
+        self._lock = threading.Lock()
+        self._window = window
+        self.recent_horizon_s = recent_horizon_s
+        self._buckets: Dict[int, _BucketCost] = {}
+        # batch-formation samples fed by the coalescer (one per dispatched
+        # batch): how long the OLDEST rider waited for the batch to form,
+        # and the realized fill — the latency the batching layer itself
+        # adds, next to the device time it buys
+        self._formation: deque = deque(maxlen=window)
+
+    def record_call(
+        self,
+        *,
+        bucket: int,
+        boards: int,
+        pad_coalesce: int,
+        pad_mesh: int,
+        device_s: float,
+        lane_steps: int = 0,
+        idle_lane_steps: int = 0,
+        deep_retry: bool = False,
+    ) -> None:
+        """Fold one finalized device call. A few int adds and a deque
+        append under one lock — per BATCH, never per request."""
+        if device_s < 0.0:
+            device_s = 0.0
+        with self._lock:
+            b = self._buckets.get(bucket)
+            if b is None:
+                b = self._buckets[bucket] = _BucketCost(self._window)
+            b.dispatches += 1
+            b.boards += boards
+            b.pad_coalesce += pad_coalesce
+            b.pad_mesh += pad_mesh
+            b.device_s += device_s
+            b.lane_steps += lane_steps
+            b.idle_lane_steps += idle_lane_steps
+            if deep_retry:
+                b.deep_retries += 1
+            b.recent.append((time.monotonic(), device_s, boards))
+
+    def note_formation(self, wait_s: float, fill: int) -> None:
+        """One coalesced batch formed: the oldest rider's queue wait and
+        the realized fill (parallel/coalescer.py dispatcher)."""
+        with self._lock:
+            self._formation.append((max(0.0, wait_s), fill))
+
+    # -- reporting -----------------------------------------------------------
+    def _bucket_entry(self, width: int, b: _BucketCost, now: float) -> dict:
+        lanes = width * b.dispatches  # slots paid for across all calls
+        rec_s = rec_boards = 0.0
+        for t, dev_s, boards in b.recent:
+            if now - t <= self.recent_horizon_s:
+                rec_s += dev_s
+                rec_boards += boards
+        return {
+            "dispatches": b.dispatches,
+            "boards": b.boards,
+            "deep_retries": b.deep_retries,
+            "device_s": round(b.device_s, 4),
+            "pps": round(b.boards / b.device_s, 1) if b.device_s else 0.0,
+            "recent_pps": round(rec_boards / rec_s, 1) if rec_s else 0.0,
+            "fill_pct": _pct(b.boards, lanes),
+            "pad_coalesce_pct": _pct(b.pad_coalesce, lanes),
+            "pad_mesh_pct": _pct(b.pad_mesh, lanes),
+            "lane_util_pct": (
+                _pct(b.lane_steps - b.idle_lane_steps, b.lane_steps)
+            ),
+            "lane_steps": b.lane_steps,
+            "idle_lane_steps": b.idle_lane_steps,
+        }
+
+    def snapshot(self, warm_info: Optional[dict] = None) -> dict:
+        """The ``engine.cost`` block: totals + per-bucket breakdown, and
+        compile amortization when the engine hands over its warm state
+        (device-seconds served per compile-second paid)."""
+        now = time.monotonic()
+        with self._lock:
+            per_bucket = {
+                str(w): self._bucket_entry(w, b, now)
+                for w, b in sorted(self._buckets.items())
+            }
+            dispatches = sum(b.dispatches for b in self._buckets.values())
+            boards = sum(b.boards for b in self._buckets.values())
+            device_s = sum(b.device_s for b in self._buckets.values())
+            pad_c = sum(b.pad_coalesce for b in self._buckets.values())
+            pad_m = sum(b.pad_mesh for b in self._buckets.values())
+            lanes = sum(
+                w * b.dispatches for w, b in self._buckets.items()
+            )
+            lane_steps = sum(b.lane_steps for b in self._buckets.values())
+            idle = sum(b.idle_lane_steps for b in self._buckets.values())
+            formation = list(self._formation)
+        out = {
+            "dispatches": dispatches,
+            "boards": boards,
+            "device_s": round(device_s, 4),
+            "pps": round(boards / device_s, 1) if device_s else 0.0,
+            "fill_pct": _pct(boards, lanes),
+            "pad_coalesce_pct": _pct(pad_c, lanes),
+            "pad_mesh_pct": _pct(pad_m, lanes),
+            "pad_waste_pct": _pct(pad_c + pad_m, lanes),
+            "lane_util_pct": _pct(lane_steps - idle, lane_steps),
+            "buckets": per_bucket,
+        }
+        if formation:
+            out["formation"] = {
+                "batches": len(formation),
+                "avg_wait_ms": round(
+                    sum(w for w, _ in formation) / len(formation) * 1e3, 3
+                ),
+                "avg_fill": round(
+                    sum(f for _, f in formation) / len(formation), 2
+                ),
+            }
+        if warm_info is not None:
+            compile_s = 0.0
+            for st in (warm_info.get("buckets") or {}).values():
+                compile_s += float(st.get("compile_s") or 0.0)
+            out["compile_amortization"] = {
+                "compile_s": round(compile_s, 3),
+                "device_s": round(device_s, 3),
+                # >1 means the fleet has already served more device time
+                # than it paid in compiles this process lifetime
+                "ratio": (
+                    round(device_s / compile_s, 3) if compile_s else 0.0
+                ),
+            }
+        return out

@@ -102,6 +102,16 @@ class FlightRecorder:
         with self._lock:
             self._spans.append(tuple(record.values()))
 
+    def spans(self) -> list:
+        """The span ring rebuilt as dicts (RECORD_FIELDS order) — the
+        trace-export route's source (obs/export.py). Rare-path cost,
+        same rationale as dump()."""
+        from .trace import RECORD_FIELDS
+
+        with self._lock:
+            rows = list(self._spans)
+        return [dict(zip(RECORD_FIELDS, row)) for row in rows]
+
     def note_event(self, kind: str, detail: Optional[dict] = None) -> None:
         """Append one control-plane event (supervisor transition, shed
         storm, dump marker) to the event ring."""
@@ -200,6 +210,17 @@ class FlightRecorder:
             "spans": [dict(zip(RECORD_FIELDS, row)) for row in spans],
             "events": events,
         }
+        try:
+            # the incident as a picture (ISSUE 10): the same spans
+            # assembled as Perfetto-loadable trace-event JSON, embedded
+            # so a dump file opens in a trace viewer with zero extra
+            # tooling. Best-effort — the black box's primary record must
+            # survive an export bug.
+            from .export import build_trace
+
+            payload["trace"] = build_trace(payload["spans"])
+        except Exception:  # noqa: BLE001 — export is additive evidence
+            logger.exception("flight-record trace export failed")
         path = None
         if self.dump_dir:
             try:

@@ -37,6 +37,7 @@ of serving throughput by ``bench.py --mode obs-overhead``.
 from __future__ import annotations
 
 import itertools
+import logging
 import os
 import re
 import threading
@@ -44,6 +45,8 @@ import time
 from typing import Optional
 
 from .histo import RouteMetrics, StageMetrics
+
+logger = logging.getLogger(__name__)
 
 # stage keys every finished record carries (absent stages render 0.0 so
 # the X-Timing header and flight-recorder rows have a fixed shape)
@@ -157,6 +160,11 @@ class Tracer:
             window=window, bounds_ms=bounds_ms or DEFAULT_BOUNDS_MS
         )
         self.routes = RouteMetrics()
+        # SLO burn-rate engine (obs/slo.py, ISSUE 10): when attached,
+        # finish() drives its rate-limited sampler — the engine needs a
+        # heartbeat that exists exactly when requests do, and all but ~1
+        # call per second return on a monotonic compare
+        self.slo = None
         # benign int races, like the coalescer's high-water marks: these
         # are monotone counters read only by /metrics, and a lock here
         # would sit on every request's hot path purely to make a debug
@@ -221,6 +229,11 @@ class Tracer:
             self.recorder.record_span(record)
             if status == 429:
                 self.recorder.note_shed()
+        if self.slo is not None:
+            try:
+                self.slo.maybe_tick()
+            except Exception:  # noqa: BLE001 — SLO eval must never fail a request
+                logger.exception("SLO tick failed")
         return record
 
     # -- observability of the observability --------------------------------

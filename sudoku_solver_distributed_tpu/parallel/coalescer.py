@@ -454,6 +454,15 @@ class BatchCoalescer:
                     self._wait_sum_s += w
                     if w > self._wait_max_s:
                         self._wait_max_s = w
+            # cost-plane formation sample (obs/cost.py, ISSUE 10): the
+            # oldest rider's wait is the latency this batch's coalescing
+            # added; one locked append per BATCH, next to the device-side
+            # sample the engine records at finalize
+            cost = getattr(self._engine, "cost", None)
+            if cost is not None:
+                cost.note_formation(
+                    now - batch[0].enqueued, len(batch)
+                )
             # span stamping (obs/trace.py), outside every lock: queue wait
             # ended at batch formation (now), the coalesce stage is the
             # stack/pad + async device enqueue that just ran; the padded

@@ -19,6 +19,7 @@ DCN across), and the per-iteration collective is a single int32.
 from __future__ import annotations
 
 import contextlib
+import time
 from functools import lru_cache, partial
 from typing import Optional, Tuple
 
@@ -26,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.trace import current_trace
 from ..ops import BoardSpec, SPEC_9
 from ..ops.propagate import analyze
 from ..ops.encode import mask_to_value
@@ -410,10 +412,20 @@ def frontier_solve(
     target = n_dev * states_per_device
 
     board = np.asarray(board, np.int32)
+    # request-span stamps (ISSUE 10 satellite — this path had zero trace
+    # stamps, so --frontier requests answered empty X-Timing device
+    # fields): seeding is this route's batch-formation analog, billed as
+    # the coalesce stage; the race itself is the device stage below. The
+    # race runs inline in the handler thread, so the thread-local span is
+    # the request's own.
+    tr = current_trace()
+    t_seed = time.monotonic()
     states, early = seed_frontier(
         board, spec, target=target, locked=locked,
         initial_states=initial_states,
     )
+    if tr is not None:
+        tr.mark("coalesce", time.monotonic() - t_seed)
     if early is not None:
         return early.tolist(), {
             "validations": 0,
@@ -443,6 +455,7 @@ def frontier_solve(
         mesh, spec, max_iters, max_depth, locked, waves, naked_pairs,
         packed, legacy_merges,
     )
+    t_dev = time.monotonic()
     if len(mesh.devices.flatten()) > len(jax.local_devices()):
         # multi-host mesh (serving_loop.py): every host ran the same
         # deterministic seeding and holds the full identical states array;
@@ -464,6 +477,9 @@ def frontier_solve(
         packed = np.asarray(
             jax.block_until_ready(racer(jnp.asarray(states)))
         )
+    if tr is not None:
+        # race dispatch → replicated-row fetch: the device stage
+        tr.mark("device", time.monotonic() - t_dev)
     C = spec.cells
     found, validations = bool(packed[C]), int(packed[C + 1])
     info = {

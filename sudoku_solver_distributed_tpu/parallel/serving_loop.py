@@ -331,7 +331,7 @@ class FrontierServingLoop:
         mesh (every pod host enters the sharded bucket program through
         the broadcast). ``boards`` is (bucket, N, N) with bucket divisible
         by the mesh size — exactly what ``engine._dispatch_padded`` hands
-        its ``mesh_runner``. Returns the packed (bucket, C+4) host rows.
+        its ``mesh_runner``. Returns the packed (bucket, C+6) host rows.
 
         Same serialization/timeout contract as ``solve``: raises if the
         loop died or the collective failed, never hangs the caller."""

@@ -19,7 +19,7 @@ Two factories:
     naked pairs / legacy escape hatch) so a sharded A/B measures the same
     loop the serving engine runs.
   * :func:`make_packed_serving_program` — the serving surface: the engine's
-    packed-row bucket program (one (B, C+4) int32 output = ONE device→host
+    packed-row bucket program (one (B, C+6) int32 output = ONE device→host
     transfer per batch, iteration budget as a traced argument) shard_mapped
     over the ``data`` axis. ``engine._dispatch_padded`` dispatches through
     it when the engine owns a mesh, and the multi-host serving loop
@@ -205,12 +205,16 @@ def make_packed_serving_program(
 ):
     """The engine's packed-row bucket program, shard_mapped over ``data``.
 
-    Returns a jitted ``fn(grids, iters) -> (B, C+4) int32`` where grids is
+    Returns a jitted ``fn(grids, iters) -> (B, C+6) int32`` where grids is
     (B, N, N) with B divisible by the mesh size, each row is
-    ``[grid | solved | status | guesses | validations]`` (ONE device→host
-    transfer per batch — the engine serving contract), and ``iters`` is the
-    TRACED iteration budget so the normal/deep/quick variants share this
-    one executable (the PR 4 compile-cost collapse, preserved on the mesh).
+    ``[grid | solved | status | guesses | validations | lane_steps |
+    idle_lane_steps]`` (ONE device→host transfer per batch — the engine
+    serving contract; the two trailing columns are the call's PR 7
+    LoopStats ``psum``-reduced over the mesh then broadcast per row, so
+    obs/cost.py reads whole-call loop-work totals from row 0 exactly as
+    on a single device), and ``iters`` is the TRACED iteration budget so
+    the normal/deep/quick variants share this one executable (the PR 4
+    compile-cost collapse, preserved on the mesh).
 
     ``solver_overrides`` is the engine's resolved --solver-config dict as a
     sorted item tuple (hashable for the memoizer): the mesh program runs
@@ -227,11 +231,16 @@ def make_packed_serving_program(
 
     def _run_shard(grid, iters):
         B = grid.shape[0]
-        res = solve_batch(
+        res, lstats = solve_batch(
             grid, spec, max_iters=iters, max_depth=max_depth,
             locked_candidates=locked_candidates, waves=waves,
-            naked_pairs=naked_pairs, **overrides,
+            naked_pairs=naked_pairs, return_stats=True, **overrides,
         )
+        # whole-call loop-work totals: each shard's LoopStats psum-reduced
+        # over the mesh, so every row of the gathered output carries the
+        # same global scalars (the single-device column contract)
+        lane = jax.lax.psum(lstats.lane_steps, "data")
+        idle = jax.lax.psum(lstats.idle_lane_steps, "data")
         # the engine's packed result row (engine._run): every field in ONE
         # int32 array so the serving path pays exactly one transfer
         return jnp.concatenate(
@@ -241,6 +250,8 @@ def make_packed_serving_program(
                 res.status[:, None],
                 res.guesses[:, None],
                 res.validations[:, None],
+                jnp.broadcast_to(lane, (B,))[:, None],
+                jnp.broadcast_to(idle, (B,))[:, None],
             ],
             axis=1,
         )

@@ -1,0 +1,661 @@
+"""Fleet observability plane (ISSUE 10, obs/cost|cluster|slo|export).
+
+Deterministic coverage of the four tentpole layers plus the satellites:
+
+  * device cost accounting — nonzero lane utilization + pad-waste split
+    on a coalesced load, formation samples, compile amortization;
+  * cluster aggregation — telemetry digest wire roundtrip with absent-key
+    back-compat and field order (wire_schema stays clean), two-node
+    convergence over real UDP gossip within one interval, TTL expiry,
+    forget-on-goodbye, hostile-digest sanitization, and the
+    /metrics/cluster JSON+prom routes on both transports;
+  * SLO burn-rate engine — burn math against synthetic histograms
+    (explicit clocks, no sleeps), conservative threshold→bucket rounding,
+    fast-burn edge triggering the flight-recorder incident dump, and the
+    acceptance shape: injected device latency (chaos set_delay) driving
+    the fast-burn gauge over threshold with the offending spans in the
+    dump;
+  * trace export — tree assembly incl. wire-propagated farm-task spans,
+    structural trace-event validity (Perfetto-loadable), the
+    GET /debug/trace route, and the flight-dump embedding;
+  * span completeness on the frontier route (probe + race device stamps)
+    — the PR 6 gap this PR closes.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.net import wire
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+from sudoku_solver_distributed_tpu.net.stats import PeerTelemetry
+from sudoku_solver_distributed_tpu.obs import (
+    FlightRecorder,
+    SloEngine,
+    StageMetrics,
+    Tracer,
+    parse_slo,
+)
+from sudoku_solver_distributed_tpu.obs.cluster import (
+    TelemetryPublisher,
+    build_digest,
+    cluster_snapshot,
+)
+from sudoku_solver_distributed_tpu.obs.export import build_trace
+from sudoku_solver_distributed_tpu.obs.slo import good_bad_counts
+from sudoku_solver_distributed_tpu.utils import EngineFaultInjector
+
+BOARD = [[0] * 9 for _ in range(9)]
+BOARD[0][0] = 5
+
+
+def free_udp_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1, 4), coalesce=True)
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+def post(port, path, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else b"",
+        headers=headers or {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.headers, json.loads(r.read())
+
+
+def get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, r.headers, r.read()
+
+
+# -- tentpole 1: device cost accounting ---------------------------------------
+
+
+def test_cost_accounting_coalesced_load(engine):
+    """A coalesced partial-fill batch records device wall time, batch
+    fill, pad waste, and nonzero lane counters — the acceptance shape."""
+    before = engine.cost.snapshot()
+    # 3 concurrent requests into the width-4 bucket: fill 3/4, pad 1
+    futs = [engine.solve_one_async(BOARD) for _ in range(3)]
+    for f in futs:
+        assert f.result(timeout=30)[0] is not None
+    snap = engine.cost.snapshot(warm_info=engine.warm_info())
+    assert snap["dispatches"] > before["dispatches"]
+    assert snap["device_s"] > 0 and snap["pps"] > 0
+    assert snap["lane_util_pct"] > 0  # LoopStats threaded off the device
+    b4 = snap["buckets"].get("4")
+    assert b4 is not None and b4["lane_steps"] > 0
+    # the pad rows are real waste, attributed to the coalescer (no mesh)
+    assert b4["pad_coalesce_pct"] > 0 and b4["pad_mesh_pct"] == 0.0
+    assert 0 < b4["fill_pct"] < 100.0
+    # the coalescer fed formation samples (wait + fill per batch)
+    assert snap["formation"]["batches"] >= 1
+    assert snap["formation"]["avg_fill"] >= 1
+    # compile amortization reads the warm plane's recorded compile costs
+    am = snap["compile_amortization"]
+    assert am["compile_s"] > 0 and am["device_s"] > 0
+
+
+def test_cost_block_rides_engine_health(engine):
+    health = engine.health()
+    assert "cost" in health
+    assert health["cost"]["boards"] >= 1
+
+
+def test_cost_pad_split_attribution():
+    """The pad-waste split: rows up to the REQUESTED ladder width bill
+    the coalescer, the mesh-rounded extra bills the mesh plane."""
+    eng = SolverEngine(buckets=(8,), bucket_multiple=3, coalesce=False)
+    # requested (8,) rounds to (9,): n=5 → pad_coalesce 3 (to 8), mesh 1
+    assert eng.buckets == (9,)
+    eng.solve_batch_np(np.tile(np.asarray(BOARD, np.int32), (5, 1, 1)))
+    b = eng.cost.snapshot()["buckets"]["9"]
+    lanes = 9
+    assert b["pad_coalesce_pct"] == pytest.approx(100 * 3 / lanes, abs=0.1)
+    assert b["pad_mesh_pct"] == pytest.approx(100 * 1 / lanes, abs=0.1)
+    eng.close()
+
+
+# -- tentpole 2: telemetry wire + cluster view --------------------------------
+
+
+def test_stats_msg_telemetry_variant_order_and_backcompat():
+    """Field order pins the reference emission order; health and
+    telemetry trail in that order; absent keys keep reference bytes."""
+    all_stats = {"all": {"solved": 0, "validations": 0}, "nodes": []}
+    base = wire.stats_msg("h:1", 0, 0, all_stats)
+    assert list(base) == ["type", "origin", "solved", "stats", "all_stats"]
+    h = wire.stats_msg("h:1", 0, 0, all_stats, health="healthy")
+    assert list(h) == [
+        "type", "origin", "solved", "stats", "all_stats", "health",
+    ]
+    t = wire.stats_msg("h:1", 0, 0, all_stats, telemetry={"v": 1})
+    assert list(t) == [
+        "type", "origin", "solved", "stats", "all_stats", "telemetry",
+    ]
+    both = wire.stats_msg(
+        "h:1", 0, 0, all_stats, health="lost", telemetry={"v": 1}
+    )
+    assert list(both) == [
+        "type", "origin", "solved", "stats", "all_stats", "health",
+        "telemetry",
+    ]
+    # codec roundtrip preserves the digest
+    rt = wire.decode_msg(wire.encode_msg(both))
+    assert rt["telemetry"] == {"v": 1} and rt["health"] == "lost"
+
+
+def test_digest_goodput_excludes_sheds():
+    """Shed 429s are recorded shed=True/error=False (histo.py) but must
+    not count as goodput — a shedding node would otherwise report
+    goodput RISING exactly while refusing work."""
+    from sudoku_solver_distributed_tpu.obs import RouteMetrics
+
+    class _Node:
+        pass
+
+    node = _Node()
+    node.metrics = RouteMetrics()
+    for _ in range(10):
+        node.metrics.record("/solve", 0.001)
+    for _ in range(7):
+        node.metrics.record("/solve", 0.0001, shed=True)
+    node.metrics.record("/solve", 0.001, error=True)
+    digest, state = build_digest(node)
+    assert digest["served_total"] == 10
+    assert digest["shed_total"] == 7
+    # rates are deltas between builds: 7 more sheds, zero more goodput
+    for _ in range(7):
+        node.metrics.record("/solve", 0.0001, shed=True)
+    digest2, _ = build_digest(node, state)
+    assert digest2["goodput_rps"] == 0.0
+    assert digest2["shed_rps"] > 0.0
+
+
+def test_peer_telemetry_sanitizes_hostile_digests():
+    pt = PeerTelemetry()
+    pt.note("p:1", {"ok": 1.5, "state": "healthy", "flag": True, "n": None})
+    assert pt.snapshot()["p:1"]["ok"] == 1.5
+    # nested structure, oversize strings, non-dict: dropped whole
+    pt.note("p:2", {"nest": {"a": 1}})
+    pt.note("p:3", {"s": "x" * 1000})
+    pt.note("p:4", ["not", "a", "dict"])
+    pt.note("p:5", {i: i for i in range(100)})
+    # NaN/inf normalize to None instead of poisoning rollups
+    pt.note("p:6", {"bad": float("nan"), "inf": float("inf")})
+    snap = pt.snapshot()
+    assert set(snap) == {"p:1", "p:6"}
+    assert snap["p:6"]["bad"] is None and snap["p:6"]["inf"] is None
+
+
+def test_peer_telemetry_ttl_expiry_and_forget():
+    pt = PeerTelemetry(ttl_s=0.15)
+    pt.note("p:1", {"v": 1})
+    pt.note("p:2", {"v": 1})
+    assert set(pt.snapshot()) == {"p:1", "p:2"}
+    pt.forget("p:2")  # goodbye
+    assert set(pt.snapshot()) == {"p:1"}
+    time.sleep(0.2)
+    assert pt.snapshot() == {}  # TTL expiry
+
+
+def test_two_node_cluster_view_convergence_and_goodbye(engine):
+    """The acceptance demo: node A's GET /metrics/cluster reports node
+    B's goodput/p99/supervisor state within one gossip interval, and
+    drops it after B's goodbye."""
+    ports = [free_udp_port(), free_udp_port()]
+    a = P2PNode("127.0.0.1", ports[0], engine=engine)
+    b = P2PNode(
+        "127.0.0.1", ports[1], anchor_node=a.id, engine=engine
+    )
+    tracer_b = Tracer()
+    b.tracer = tracer_b
+    b.metrics = tracer_b.routes
+    b.telemetry = TelemetryPublisher(b, min_interval_s=0.1)
+    threads = [
+        threading.Thread(target=n.run, daemon=True) for n in (a, b)
+    ]
+    for t in threads:
+        t.start()
+    httpd = make_http_server(a, "127.0.0.1", 0, expose_metrics=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        # a request on B gives its digest a nonzero latency/goodput view
+        tr = tracer_b.start("/solve")
+        b.peer_sudoku_solve_info(BOARD)
+        tracer_b.finish(tr, 200)
+        assert wait_for(
+            lambda: b.id in a.peer_telemetry.snapshot(), timeout=10.0
+        ), "telemetry never arrived over gossip"
+        # the 1 Hz heartbeat refreshes the digest: wait for the one that
+        # carries the traced request's latency view (the first arrival
+        # can predate the span's finish)
+        assert wait_for(
+            lambda: (
+                a.peer_telemetry.snapshot()
+                .get(b.id, {})
+                .get("p99_ms") or 0
+            ) > 0,
+            timeout=10.0,
+        ), "refreshed digest never arrived"
+        _s, _h, raw = get(httpd.server_address[1], "/metrics/cluster")
+        view = json.loads(raw)
+        peer = view["peers"][b.id]
+        assert peer["fresh"] is True and peer["age_s"] < 5.0
+        assert "goodput_rps" in peer and "p99_ms" in peer
+        assert peer["p99_ms"] > 0  # B really served a traced request
+        assert view["fleet"]["nodes"] == 2
+        # prom spelling serves per-node labeled gauges for the peer
+        _s, _h, prom = get(
+            httpd.server_address[1], "/metrics/cluster.prom"
+        )
+        assert f'node="{b.id}"'.encode() in prom
+        # goodbye: B departs gracefully; A forgets its telemetry
+        b.shutdown()
+        assert wait_for(
+            lambda: b.id not in a.peer_telemetry.snapshot(), timeout=10.0
+        ), "telemetry survived the goodbye"
+        _s, _h, raw = get(httpd.server_address[1], "/metrics/cluster")
+        assert b.id not in json.loads(raw)["peers"]
+    finally:
+        httpd.shutdown()
+        a.shutdown()
+        b.shutdown_flag = True
+        for t in threads:
+            t.join(timeout=3)
+
+
+def test_cluster_route_404_without_metrics_flag(engine):
+    node = P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+    httpd = make_http_server(node, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(httpd.server_address[1], "/metrics/cluster")
+        assert e.value.code == 404
+    finally:
+        httpd.shutdown()
+
+
+# -- tentpole 3: SLO burn-rate engine -----------------------------------------
+
+
+def _observe_total(stages, seconds, n):
+    for _ in range(n):
+        stages.observe("total", seconds)
+
+
+def test_good_bad_counts_conservative_rounding():
+    """A threshold between bucket bounds rounds DOWN: requests in the
+    straddling bucket count bad — burn is never under-reported."""
+    stages = StageMetrics()
+    _observe_total(stages, 0.55, 4)   # lands in the (500, 1000] bucket
+    snap = stages.histograms()["total"]
+    total, bad = good_bad_counts(snap, 600.0)
+    assert (total, bad) == (4, 4)     # 550 ms < 600 ms but still "bad"
+    total, bad = good_bad_counts(snap, 1000.0)
+    assert (total, bad) == (4, 0)     # exactly on a bound: exact
+
+
+def test_burn_rate_math_synthetic_histograms():
+    """Burn = (bad fraction / error budget) over the window, with
+    explicit clocks — no sleeps, no real traffic."""
+    stages = StageMetrics()
+    slo = SloEngine(
+        stages,
+        [parse_slo("latency_p99_ms=500@99")],  # budget = 1%
+        windows_s=(60.0, 600.0),
+        tick_interval_s=0.0,
+    )
+    slo.tick(now=0.0)
+    _observe_total(stages, 0.001, 99)
+    _observe_total(stages, 1.0, 1)
+    slo.tick(now=30.0)
+    snap = slo.snapshot()
+    obj = snap["objectives"]["latency_p99_ms"]
+    # 1 bad / 100 total on a 1% budget: burning exactly at budget rate
+    assert obj["burn_60s"] == pytest.approx(1.0, abs=0.01)
+    assert obj["fast_burn"] is False and snap["fast_burn_active"] is False
+    # a breach: 50 more bad requests → burn (51/150)/0.01 = 34x
+    _observe_total(stages, 1.0, 50)
+    slo.tick(now=31.0)
+    snap = slo.snapshot()
+    obj = snap["objectives"]["latency_p99_ms"]
+    assert obj["burn_60s"] > 14.4 and obj["burn_600s"] > 14.4
+    assert obj["fast_burn"] is True and snap["fast_burn_active"] is True
+    assert snap["fast_burn_events"] == 1
+    # staying in breach is ONE event (edge-triggered, not level)
+    _observe_total(stages, 1.0, 10)
+    slo.tick(now=32.0)
+    assert slo.snapshot()["fast_burn_events"] == 1
+
+
+def test_parse_slo_shapes_and_errors():
+    o = parse_slo("latency_p99_ms=500@99.9")
+    assert (o.stage, o.threshold_ms, o.objective_pct) == ("total", 500.0, 99.9)
+    assert o.error_budget == pytest.approx(0.001)
+    d = parse_slo("device_latency_p95_ms=50@99")
+    assert d.stage == "device"
+    for bad in ("nonsense", "latency_p99_ms=500", "latency_p99_ms=0@99",
+                "latency_p99_ms=500@100", "latency_p99_ms=500@0",
+                # a typo'd stage must fail the BOOT — it would otherwise
+                # bind to an empty histogram and never fire
+                "devcie_latency_p99_ms=50@99"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+
+
+def test_fast_burn_triggers_flight_dump(tmp_path):
+    """A fast-burn crossing records an slo-fast-burn event and triggers
+    the incident auto-dump — the recorder becomes alert-triggered."""
+    flight = FlightRecorder(dump_dir=str(tmp_path), incident_delay_s=0.05)
+    stages = StageMetrics()
+    slo = SloEngine(
+        stages,
+        [parse_slo("latency_p99_ms=100@99")],
+        recorder=flight,
+        windows_s=(60.0, 600.0),
+        tick_interval_s=0.0,
+    )
+    slo.tick(now=0.0)
+    _observe_total(stages, 1.0, 20)  # every request over threshold
+    slo.tick(now=1.0)
+    assert wait_for(lambda: flight.stats()["dumps"] >= 1, timeout=5.0)
+    assert flight.stats()["last_dump_reason"] == "slo-fast-burn"
+    with open(flight.stats()["last_dump_path"]) as f:
+        payload = json.load(f)
+    events = [e for e in payload["events"] if e["kind"] == "slo-fast-burn"]
+    assert events and events[0]["slo"] == "latency_p99_ms"
+    assert events[0]["burn"]["60s"] > 14.4
+
+
+def test_injected_latency_drives_fast_burn_with_spans(engine, tmp_path):
+    """The acceptance shape end to end: chaos set_delay inflates real
+    device calls past the objective, the fast-burn gauge crosses, and
+    the dump contains the SLO event AND the offending spans."""
+    flight = FlightRecorder(dump_dir=str(tmp_path), incident_delay_s=0.05)
+    tracer = Tracer(recorder=flight)
+    slo = SloEngine(
+        tracer.stages,
+        [parse_slo("latency_p99_ms=10@99")],
+        recorder=flight,
+        windows_s=(30.0, 60.0),
+        tick_interval_s=0.0,
+    )
+    tracer.slo = slo
+    inj = EngineFaultInjector()
+    engine.fault_injector = inj
+    inj.set_delay(0.05)  # every fetch +50 ms ≫ the 10 ms objective
+    try:
+        for _ in range(6):
+            t = tracer.start("/solve")
+            solution, _info = engine.solve_one(BOARD)
+            tracer.finish(t, 200)
+            assert solution is not None
+        slo.tick()
+        snap = slo.snapshot()
+        obj = snap["objectives"]["latency_p99_ms"]
+        assert snap["fast_burn_active"] is True, snap
+        assert obj["burn_30s"] > 14.4
+        assert wait_for(lambda: flight.stats()["dumps"] >= 1, timeout=5.0)
+        assert flight.stats()["last_dump_reason"] == "slo-fast-burn"
+        with open(flight.stats()["last_dump_path"]) as f:
+            payload = json.load(f)
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "slo-fast-burn" in kinds
+        # the offending spans are in the dump, delay visible as device ms
+        slow = [s for s in payload["spans"] if s["device_ms"] >= 40.0]
+        assert slow, payload["spans"]
+        # ...and the dump embeds the Perfetto trace of those spans
+        assert payload["trace"]["traceEvents"]
+    finally:
+        inj.clear()
+        engine.fault_injector = None
+
+
+# -- tentpole 4: trace export -------------------------------------------------
+
+
+def _span(tracer, route, trace_id, stages_ms, farmed=False):
+    t = tracer.start(route, trace_id=trace_id)
+    for stage, ms in stages_ms.items():
+        t.mark(stage, ms / 1e3)
+    t.farmed = farmed
+    return tracer.finish(t, 200)
+
+
+def test_trace_export_tree_assembly_with_farmed_spans():
+    flight = FlightRecorder(dump_dir=None)
+    tracer = Tracer(recorder=flight)
+    _span(
+        tracer, "/solve", "T1",
+        {"queue": 1.0, "coalesce": 0.5, "device": 4.0, "verify": 0.3},
+        farmed=True,
+    )
+    _span(tracer, "farm-task", "T1", {"device": 2.0}, farmed=True)
+    _span(tracer, "/solve", "T2", {"device": 1.0})
+    doc = build_trace(flight.spans())
+    events = doc["traceEvents"]
+    # structurally valid trace-event JSON: every X event has the fields
+    # Perfetto requires, and it round-trips through json
+    assert json.loads(json.dumps(doc))["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    for e in xs:
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["pid"] in (1, 2) and e["tid"] >= 1 and e["name"]
+    # the master span and its farmed span share a track (one tree)...
+    t1 = [e for e in xs if e.get("args", {}).get("trace_id") == "T1"]
+    assert len({e["tid"] for e in t1}) == 1
+    # ...but render under distinct process lanes (serving vs farm)
+    assert {e["pid"] for e in t1 if e["cat"] == "request"} == {1, 2}
+    # stage children laid out inside the parent, in stage order
+    solve_parent = next(
+        e for e in t1 if e["cat"] == "request" and e["pid"] == 1
+    )
+    stages = [
+        e for e in xs
+        if e["cat"] == "stage" and e["tid"] == solve_parent["tid"]
+        and e["pid"] == 1
+    ]
+    assert [s["name"] for s in stages] == [
+        "queue", "coalesce", "device", "verify",
+    ]
+    assert stages[0]["ts"] == solve_parent["ts"]
+    for earlier, later in zip(stages, stages[1:]):
+        assert later["ts"] == pytest.approx(
+            earlier["ts"] + earlier["dur"]
+        )
+    # T2 lives on its own track
+    t2 = [e for e in xs if e.get("args", {}).get("trace_id") == "T2"]
+    assert {e["tid"] for e in t2} != {e["tid"] for e in t1}
+    # trace_id filter narrows to one tree
+    only = build_trace(flight.spans(), trace_id="T2")
+    assert all(
+        e.get("args", {}).get("trace_id") == "T2"
+        for e in only["traceEvents"]
+        if e["ph"] == "X"
+    )
+
+
+def test_debug_trace_route_and_404(engine):
+    flight = FlightRecorder(dump_dir=None)
+    tracer = Tracer(recorder=flight)
+    node = P2PNode(
+        "127.0.0.1", free_udp_port(), engine=engine,
+        metrics=tracer.routes,
+    )
+    node.tracer = tracer
+    node.flight = flight
+    httpd = make_http_server(node, "127.0.0.1", 0, expose_metrics=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        port = httpd.server_address[1]
+        post(port, "/solve", {"sudoku": BOARD})
+        _s, _h, raw = get(port, "/debug/trace")
+        doc = json.loads(raw)
+        assert doc["traceEvents"]
+        assert any(
+            e["ph"] == "X" and e["name"] == "/solve"
+            for e in doc["traceEvents"]
+        )
+        assert any(
+            e["ph"] == "X" and e["cat"] == "stage" and e["name"] == "device"
+            for e in doc["traceEvents"]
+        )
+    finally:
+        httpd.shutdown()
+    # recorder-less node: the route does not exist
+    bare = P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+    httpd2 = make_http_server(bare, "127.0.0.1", 0, expose_metrics=True)
+    threading.Thread(target=httpd2.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(httpd2.server_address[1], "/debug/trace")
+        assert e.value.code == 404
+    finally:
+        httpd2.shutdown()
+
+
+# -- satellite: frontier-route span completeness ------------------------------
+
+
+def test_frontier_probe_span_has_device_time():
+    """Auto-routed frontier requests answered by the quick probe used to
+    return device_ms=0 — the probe is device work and is now stamped."""
+    from sudoku_solver_distributed_tpu.parallel import default_mesh
+
+    eng = SolverEngine(
+        buckets=(1,),
+        coalesce=False,
+        frontier_mesh=default_mesh(),
+        frontier_route="auto",
+    )
+    eng.warmup()
+    tracer = Tracer()
+    try:
+        t = tracer.start("/solve")
+        solution, info = eng.solve_one(BOARD)
+        rec = tracer.finish(t, 200)
+        assert solution is not None
+        assert rec["device_ms"] > 0, rec
+    finally:
+        eng.close()
+
+
+def test_frontier_race_span_stamps_seeding_and_device():
+    """A board that escalates to the race stamps seeding as coalesce and
+    the race as device (parallel/frontier.py had zero stamps)."""
+    from sudoku_solver_distributed_tpu.parallel import default_mesh
+
+    import jax
+
+    # a DEEP board on a ONE-device mesh: the suite's 8 virtual devices
+    # would push the seeding target to 512 states, enough rounds for the
+    # BFS to solve even a deep board early (device_ms legitimately 0) —
+    # one device keeps the target at 64 and the race must actually run
+    hard = np.load("benchmarks/corpus_9x9_deep_128.npz")["boards"][0]
+    eng = SolverEngine(
+        buckets=(1,),
+        coalesce=False,
+        frontier_mesh=default_mesh(jax.devices()[:1]),
+        frontier_route="always",
+    )
+    eng.warmup()
+    tracer = Tracer()
+    try:
+        t = tracer.start("/solve")
+        solution, info = eng.solve_one(hard.tolist())
+        rec = tracer.finish(t, 200)
+        assert solution is not None
+        assert info.get("frontier"), info
+        assert rec["coalesce_ms"] > 0, rec  # seeding
+        assert rec["device_ms"] > 0, rec    # the race itself
+    finally:
+        eng.close()
+
+
+# -- satellite: /metrics parity incl. cost + device-trace counters ------------
+
+
+def test_metrics_json_prom_parity_with_cost_and_device_trace(tmp_path):
+    """Byte-identical /metrics JSON and prom on BOTH transports with the
+    new engine.cost block and the warm-plane device_trace counters
+    present (extends the PR 6 parity contract)."""
+    eng = SolverEngine(buckets=(1,), coalesce=True)
+    eng.arm_device_trace(str(tmp_path), calls=0)
+    eng.warmup()
+    flight = FlightRecorder(dump_dir=None)
+    tracer = Tracer(recorder=flight)
+    node = P2PNode(
+        "127.0.0.1", free_udp_port(), engine=eng, metrics=tracer.routes
+    )
+    node.tracer = tracer
+    node.flight = flight
+    fast = make_http_server(node, "127.0.0.1", 0, expose_metrics=True)
+    legacy = make_http_server(
+        node, "127.0.0.1", 0, expose_metrics=True, legacy_transport=True
+    )
+    for s in (fast, legacy):
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    try:
+        post(fast.server_address[1], "/solve", {"sudoku": BOARD})
+        # freeze the cost plane's recent-pps horizon race by scraping
+        # back to back on a quiescent node
+        _s, _h, json_fast = get(fast.server_address[1], "/metrics")
+        _s, _h, json_legacy = get(legacy.server_address[1], "/metrics")
+        assert json_fast == json_legacy
+        body = json.loads(json_fast)
+        assert body["engine"]["cost"]["boards"] >= 1
+        assert body["engine"]["warm"]["device_trace"]["calls_remaining"] == 0
+        _s, _h, prom_fast = get(fast.server_address[1], "/metrics.prom")
+        _s, _h, prom_legacy = get(
+            legacy.server_address[1], "/metrics.prom"
+        )
+        assert prom_fast == prom_legacy
+        text = prom_fast.decode()
+        # the new blocks flatten into gauges
+        assert "sudoku_engine_cost_lane_util_pct" in text
+        assert "sudoku_engine_cost_pps" in text
+        assert "sudoku_engine_warm_device_trace_captured_calls" in text
+        # prom values agree with the JSON body they were rendered from
+        cost = body["engine"]["cost"]
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("sudoku_engine_cost_boards ")
+        )
+        assert float(line.split()[-1]) == cost["boards"]
+    finally:
+        fast.shutdown()
+        legacy.shutdown()
+        eng.close()
