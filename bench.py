@@ -48,6 +48,14 @@ Modes (BENCH_MODE env var):
     alternating windows, plus lane-step/idle-lane counter proofs and a
     one-straggler phase showing finished boards stop iterating.
     Artifact benchmarks/hotloop_pr7.json; ``--smoke`` for CI plumbing.
+  continuous — the continuous-batching A/B (ISSUE 12): the open-loop
+    segmented serving loop with mid-flight lane refill (the PR 12
+    default) vs the closed-loop dispatcher (--no-continuous), replaying
+    one Poisson schedule at 2x measured capacity on a mixed easy/deep
+    pool in order-flipped paired windows; sustained lane utilization
+    (engine.cost loop-work deltas), deadline-conditioned p99, goodput,
+    and bit-parity hashes vs the closed-loop batch reference. Artifact
+    benchmarks/continuous_pr12.json; ``--smoke`` for CI.
   tpu-window — first-class claim-window harness (the fold of the
     tpu_session_retry*.sh scanners): scan the relay ports, bake the
     compile plane within a budget, run the headline ladder, and emit a
@@ -133,6 +141,51 @@ def _load_corpus():
     os.makedirs(os.path.dirname(CORPUS_PATH), exist_ok=True)
     np.savez_compressed(CORPUS_PATH, boards=boards)
     return boards
+
+
+def run_paired_windows(arms, pairs, ratio_of):
+    """THE shared paired-window measurement discipline — one definition
+    for ``--mode hotloop``, ``--mode obs-overhead``, and ``--mode
+    continuous`` (it used to be re-copied per mode).
+
+    ``arms`` is an ordered list of ``(name, fn)`` where each ``fn()``
+    runs ONE measurement window for that arm and returns its scalar
+    measure (seconds, pps — the caller's choice; side bookkeeping lives
+    in the closure). Every pair runs each arm once, with the execution
+    order FLIPPED on odd pairs: consecutive windows are not exchangeable
+    on a small shared host (burst credits / throttle decay inside a
+    pair), and a fixed order turns that decay into fake arm overhead.
+
+    ``ratio_of`` is ``(numerator_name, denominator_name)``; the headline
+    is the MEDIAN of per-pair ratios (``statistics.median`` — the even-
+    count case averages the middle pair rather than picking the luckier
+    window) — robust to episodic single-window scheduler stalls, unlike
+    the aggregate ratio.
+
+    Returns ``(rows, ratios_sorted, median_ratio)``; each row carries
+    ``{"order": [...], <name>: measure..., "ratio": r}``.
+    """
+    import statistics
+
+    names = [n for n, _ in arms]
+    fns = dict(arms)
+    num, den = ratio_of
+    rows = []
+    for p in range(pairs):
+        order = list(names) if p % 2 == 0 else list(reversed(names))
+        vals = {}
+        for name in order:
+            vals[name] = fns[name]()
+        rows.append(
+            {
+                "order": order,
+                **{n: round(vals[n], 4) for n in names},
+                "ratio": round(vals[num] / vals[den], 4) if vals[den] else 0.0,
+            }
+        )
+    ratios = sorted(r["ratio"] for r in rows)
+    median = round(statistics.median(ratios), 4) if ratios else 0.0
+    return rows, ratios, median
 
 
 def main():
@@ -1818,22 +1871,31 @@ def main_obs_overhead():
         deadline = time.time() + 240
         wait_ready(proc_on, PORT_ON, deadline)
         wait_ready(proc_off, PORT_OFF, deadline)
-        for _w in range(max(1, windows)):
-            pair = [("off", PORT_OFF), ("on", PORT_ON)]
-            if _w % 2:
-                # order-balance: consecutive windows are NOT exchangeable
-                # on a small host (burst credits / throttle decay within
-                # a pair), and a fixed order turns that decay into fake
-                # arm overhead (see docstring)
-                pair.reverse()
-            for arm, port in pair:
+        def arm_window(arm, port):
+            def run():
                 c0 = cpu_s(arm_proc[arm].pid)
                 n, wall = drive(port)
                 cpu[arm][0] += cpu_s(arm_proc[arm].pid) - c0
                 cpu[arm][1] += n
-                phases[arm].append(round(n / wall, 1))
+                pps = n / wall
+                phases[arm].append(round(pps, 1))
                 totals[arm][0] += n
                 totals[arm][1] += wall
+                return pps
+
+            return run
+
+        # order-flipped paired windows + median-of-ratios headline via
+        # the shared helper (run_paired_windows — the third copy of this
+        # logic is gone; see --mode hotloop / --mode continuous)
+        _rows, paired, median_paired = run_paired_windows(
+            [
+                ("off", arm_window("off", PORT_OFF)),
+                ("on", arm_window("on", PORT_ON)),
+            ],
+            max(1, windows),
+            ratio_of=("on", "off"),
+        )
         # one opt-in X-Timing request proves the header end to end
         req = urllib.request.Request(
             f"http://127.0.0.1:{PORT_ON}/solve",
@@ -1868,14 +1930,7 @@ def main_obs_overhead():
         arm: round(c / n * 1e6, 1) if n else None
         for arm, (c, n) in cpu.items()
     }
-    # per-window paired ratios (each on-window against the immediately
-    # preceding off-window — same weather) plus the off-arm's own
-    # spread: the reader's noise gauge for a shared box
-    paired = sorted(
-        round(o / f, 4) if f else 0.0
-        for o, f in zip(phases["on"], phases["off"])
-    )
-    median_paired = paired[len(paired) // 2] if paired else 0.0
+    # the off-arm's own spread: the reader's noise gauge for a shared box
     off_spread = (
         round(max(phases["off"]) / min(phases["off"]), 3)
         if min(phases["off"]) > 0
@@ -1910,7 +1965,12 @@ def main_obs_overhead():
         t = tracer.start("/solve")
         eng.solve_one_supervised(board)
         tracer.finish(t, 200)
+        # poison both widths the coalesced path may dispatch at: the
+        # continuous segment driver (PR 12 default) runs its lane pool at
+        # the bucket covering the batch cap (4 here); the closed-loop arm
+        # would dispatch the lone request at width 1
         inj.poison_bucket(1)
+        inj.poison_bucket(4)
         t = tracer.start("/solve")
         sol, info = eng.solve_one_supervised(board)
         tracer.finish(t, 200, degraded=bool(info.get("degraded")))
@@ -2141,21 +2201,26 @@ def main_hotloop():
         jax.block_until_ready(outs[-1])
         return (time.perf_counter() - t0) / per_window
 
-    pair_rows = []
-    for p in range(pairs):
-        order = ("default", "legacy") if p % 2 == 0 else ("legacy", "default")
-        times = {}
-        for name in order:
-            times[name] = window(fns[name])
-        pair_rows.append(
-            {
-                "order": list(order),
-                "default_s": round(times["default"], 4),
-                "legacy_s": round(times["legacy"], 4),
-                "ratio": round(times["legacy"] / times["default"], 4),
-            }
-        )
-    ratio = statistics.median(r["ratio"] for r in pair_rows)
+    # order-flipped paired windows, median-of-ratios headline: the shared
+    # discipline (run_paired_windows — one definition with obs-overhead
+    # and --mode continuous)
+    rows, _ratios, ratio = run_paired_windows(
+        [
+            ("default", lambda: window(fns["default"])),
+            ("legacy", lambda: window(fns["legacy"])),
+        ],
+        pairs,
+        ratio_of=("legacy", "default"),
+    )
+    pair_rows = [
+        {
+            "order": r["order"],
+            "default_s": r["default"],
+            "legacy_s": r["legacy"],
+            "ratio": r["ratio"],
+        }
+        for r in rows
+    ]
     default_pps = B / statistics.median(r["default_s"] for r in pair_rows)
     legacy_pps = B / statistics.median(r["legacy_s"] for r in pair_rows)
 
@@ -2250,6 +2315,350 @@ def main_hotloop():
         f"| artifact: {out_path}",
         file=sys.stderr,
     )
+
+
+def main_continuous():
+    """Continuous batching A/B (ISSUE 12): the open-loop segmented device
+    loop with mid-flight lane refill (the PR 12 serving default) vs the
+    closed-loop run-to-completion dispatcher (``--no-continuous``), under
+    an OPEN-LOOP Poisson load at BENCH_CONTINUOUS_X (default 2×) the
+    measured closed-loop capacity, on a mixed easy/deep request pool —
+    the exact traffic shape where a deep straggler pins a closed batch
+    while fresh arrivals queue.
+
+    Both arms replay the IDENTICAL arrival schedule in order-flipped
+    paired windows (run_paired_windows — the shared discipline with
+    hotloop/obs-overhead). Per window:
+
+      * sustained lane utilization — windowed delta of the engine.cost
+        lane/idle loop-work counters (the device-side truth both arms
+        share: a swept lane whose board already finished, or that holds
+        padding, is idle); the headline paired ratio.
+      * deadline-conditioned p99/p50 — latency percentiles over ANSWERED
+        requests (sheds excluded; every request carries an
+        X-Deadline-Ms-style budget through solve_one_async).
+      * goodput — answered boards/s.
+
+    Parity gate: every answered solution must equal the closed-loop
+    batch reference bit-for-bit, and the artifact carries per-arm sha256
+    hashes over the (window, request, solution) stream of requests
+    answered in BOTH arms — equal hashes = bit-identical answers under
+    mid-flight lane rotation.
+
+    Artifact: benchmarks/continuous_pr12.json (BENCH_CONTINUOUS_OUT
+    overrides). ``--smoke`` (or BENCH_CONTINUOUS_SMOKE=1): short windows
+    for CI plumbing.
+    """
+    smoke = (
+        "--smoke" in sys.argv[1:]
+        or os.environ.get("BENCH_CONTINUOUS_SMOKE") == "1"
+    )
+    import hashlib
+    import statistics
+    import threading
+
+    import jax
+
+    platform = os.environ.get("BENCH_PLATFORM", "cpu")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.engine import SolverEngine
+    from sudoku_solver_distributed_tpu.models import generate_batch
+    from sudoku_solver_distributed_tpu.serving.admission import (
+        DeadlineExceeded,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get(
+        "BENCH_CONTINUOUS_OUT",
+        os.path.join(repo, "benchmarks", "continuous_pr12.json"),
+    )
+    pairs = int(
+        os.environ.get("BENCH_CONTINUOUS_PAIRS", "2" if smoke else "3")
+    )
+    secs = float(
+        os.environ.get("BENCH_CONTINUOUS_SECS", "1.5" if smoke else "6")
+    )
+    over_x = float(os.environ.get("BENCH_CONTINUOUS_X", "2"))
+    deadline_ms = float(
+        os.environ.get("BENCH_CONTINUOUS_DEADLINE_MS", "400")
+    )
+
+    # pin to one core on CPU (the hotloop/overload discipline): the A/B
+    # must not drown in scheduler migration noise on a small shared host
+    pinned = False
+    if hasattr(os, "sched_setaffinity") and platform == "cpu":
+        try:
+            cores = sorted(os.sched_getaffinity(0))
+            os.sched_setaffinity(0, {cores[0]})
+            pinned = True
+        except OSError:
+            pass
+
+    # Mixed easy/deep pool: 3/4 singles-solvable easy mass + the committed
+    # hard corpus as the deep tail, shuffled with a fixed seed so both
+    # arms (and every rerun) see the identical request stream.
+    hard_path = os.path.join(repo, "benchmarks", "corpus_9x9_hard_64.npz")
+    hard = (
+        np.load(hard_path)["boards"]
+        if os.path.exists(hard_path)
+        else generate_batch(64, 64, seed=20260729, unique=True)
+    )
+    easy = generate_batch(192, 30, seed=20260804)
+    pool = np.concatenate([easy, hard], axis=0)
+    pool = pool[np.random.default_rng(20260804).permutation(len(pool))]
+
+    # the parity reference: the pool solved once through the closed-loop
+    # batch path — every answered open-loop request must match its row
+    ref_eng = SolverEngine(buckets=(8,), coalesce=False, continuous=False)
+    ref_solutions, ref_mask, _ = ref_eng.solve_batch_np(pool)
+    assert bool(ref_mask.all()), "parity reference failed to solve the pool"
+    ref_hash = hashlib.sha256(
+        np.ascontiguousarray(ref_solutions, np.int32).tobytes()
+    ).hexdigest()
+
+    def make_engine(continuous):
+        kw = dict(
+            buckets=(1, 8), coalesce_max_batch=8, continuous=continuous
+        )
+        seg = os.environ.get("BENCH_CONTINUOUS_SEGMENT_ITERS")
+        if continuous and seg:
+            kw["segment_iters"] = int(seg)
+        eng = SolverEngine(**kw)
+        eng.warmup()
+        return eng
+
+    engines = {
+        "continuous": make_engine(True),
+        "closed": make_engine(False),
+    }
+
+    # closed-loop capacity of the CLOSED arm sets the open-loop rate
+    def measure_capacity(eng, warm_s=1.5, clients=8):
+        stop = time.monotonic() + warm_s
+        counts = [0] * clients
+
+        def client(i):
+            while time.monotonic() < stop:
+                sol, _ = eng.solve_one(
+                    pool[(i * 31 + counts[i]) % len(pool)].tolist()
+                )
+                assert sol is not None
+                counts[i] += 1
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / warm_s
+
+    capacity = measure_capacity(engines["closed"])
+    rate = max(10.0, over_x * capacity)
+
+    # ONE Poisson schedule, replayed identically by every window/arm
+    sched_rng = np.random.default_rng(20260805)
+    arrivals = []
+    t = 0.0
+    seq = 0
+    while t < secs:
+        arrivals.append((t, seq))
+        t += float(sched_rng.exponential(1.0 / rate))
+        seq += 1
+
+    answered_by_arm = {"continuous": {}, "closed": {}}
+    window_stats = {"continuous": [], "closed": []}
+    window_idx = {"n": 0}
+
+    def drive(arm):
+        """Replay the schedule open-loop against one arm; returns the
+        window's sustained utilization (the paired measure) and appends
+        the full stat row."""
+        eng = engines[arm]
+        w = window_idx["n"]
+        window_idx["n"] += 1
+        c0 = eng.cost.snapshot()
+        lock = threading.Lock()
+        lats, shed, failed = [], [0], [0]
+        futs = []
+        t0 = time.monotonic()
+        for dt, s in arrivals:
+            target = t0 + dt
+            now = time.monotonic()
+            if target > now:
+                time.sleep(target - now)
+            t_sub = time.monotonic()
+            fut = eng.solve_one_async(
+                pool[s % len(pool)].tolist(),
+                deadline_s=t_sub + deadline_ms / 1e3,
+            )
+
+            def on_done(f, s=s, t_sub=t_sub, w=w):
+                t_done = time.monotonic()
+                try:
+                    sol, _info = f.result()
+                except DeadlineExceeded:
+                    with lock:
+                        shed[0] += 1
+                    return
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    with lock:
+                        failed[0] += 1
+                    return
+                with lock:
+                    lats.append(t_done - t_sub)
+                    # pair index (w//2): both arms of a pair replay the
+                    # same schedule, so (pair, seq) names one request
+                    answered_by_arm[arm][(w // 2, s)] = (
+                        None
+                        if sol is None
+                        else np.asarray(sol, np.int32).tobytes()
+                    )
+                    if sol is not None and not np.array_equal(
+                        np.asarray(sol, np.int32), ref_solutions[s % len(pool)]
+                    ):
+                        failed[0] += 10**6  # parity violation — loud
+
+            fut.add_done_callback(on_done)
+            futs.append(fut)
+        for f in futs:
+            try:
+                f.result(timeout=120)
+            except Exception:  # noqa: BLE001 — already counted
+                pass
+        wall = time.monotonic() - t0
+        c1 = eng.cost.snapshot()
+        dlane = c1["lane_steps"] - c0["lane_steps"]
+        didle = c1["idle_lane_steps"] - c0["idle_lane_steps"]
+        util = 100.0 * (dlane - didle) / dlane if dlane else 0.0
+        lat_sorted = sorted(lats)
+
+        def pct(q):
+            return (
+                round(lat_sorted[int(q * (len(lat_sorted) - 1))] * 1e3, 2)
+                if lat_sorted
+                else 0.0
+            )
+
+        row = {
+            "arm": arm,
+            "answered": len(lats),
+            "shed": shed[0],
+            "failed": failed[0],
+            "goodput_pps": round(len(lats) / wall, 1),
+            "util_pct": round(util, 2),
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+        }
+        window_stats[arm].append(row)
+        return util
+
+    rows, ratios, util_ratio = run_paired_windows(
+        [
+            ("continuous", lambda: drive("continuous")),
+            ("closed", lambda: drive("closed")),
+        ],
+        pairs,
+        ratio_of=("continuous", "closed"),
+    )
+
+    seg_iters = engines["continuous"].segment_iters
+    for eng in engines.values():
+        eng.close()
+
+    # parity hashes over the requests answered in BOTH arms: equal hashes
+    # = bit-identical answers under mid-flight lane rotation
+    common = sorted(
+        set(answered_by_arm["continuous"]) & set(answered_by_arm["closed"])
+    )
+    hashes = {}
+    for arm in ("continuous", "closed"):
+        h = hashlib.sha256()
+        for key in common:
+            h.update(repr(key).encode())
+            h.update(answered_by_arm[arm][key] or b"unsolved")
+        hashes[arm] = h.hexdigest()
+    parity_ok = (
+        hashes["continuous"] == hashes["closed"]
+        and all(r["failed"] == 0 for rows_ in window_stats.values() for r in rows_)
+    )
+
+    def med(arm, key):
+        vals = [r[key] for r in window_stats[arm]]
+        return round(statistics.median(vals), 2) if vals else 0.0
+
+    cont_snapshot = None
+    record = {
+        "metric": "continuous_batching_sustained_lane_util_pct_9x9",
+        "value": med("continuous", "util_pct"),
+        "unit": "pct_lanes_busy",
+        # >1.0 = the open-loop refill bought busier lanes than the
+        # closed loop under the identical overload schedule
+        "vs_baseline": round(util_ratio, 4),
+        "closed_util_pct": med("closed", "util_pct"),
+        "p99_ms": {
+            "continuous": med("continuous", "p99_ms"),
+            "closed": med("closed", "p99_ms"),
+        },
+        "p50_ms": {
+            "continuous": med("continuous", "p50_ms"),
+            "closed": med("closed", "p50_ms"),
+        },
+        "goodput_pps": {
+            "continuous": med("continuous", "goodput_pps"),
+            "closed": med("closed", "goodput_pps"),
+        },
+        "capacity_pps_closed_loop": round(capacity, 1),
+        "open_loop_rate_pps": round(rate, 1),
+        "overload_x": over_x,
+        "deadline_ms": deadline_ms,
+        "window_secs": secs,
+        "pairs": pairs,
+        "requests_per_window": len(arrivals),
+        "platform": platform,
+        "pinned_core": pinned,
+        "pool": {
+            "boards": int(len(pool)),
+            "easy": int(len(easy)),
+            "deep": int(len(hard)),
+        },
+        "segment_iters": seg_iters,
+        "paired_util_rows": rows,
+        "paired_util_ratios_sorted": ratios,
+        "windows": window_stats,
+        "parity": {
+            "ok": parity_ok,
+            "common_answers": len(common),
+            "hashes": hashes,
+            "reference_hash": ref_hash,
+        },
+        "smoke": smoke,
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    headline = {
+        k: record[k] for k in ("metric", "value", "unit", "vs_baseline")
+    }
+    print(json.dumps(headline))
+    print(
+        f"# continuous: util {record['value']}% vs closed "
+        f"{record['closed_util_pct']}% (ratio {util_ratio:.3f}) | p99 "
+        f"{record['p99_ms']['continuous']} vs {record['p99_ms']['closed']} ms "
+        f"| goodput {record['goodput_pps']['continuous']} vs "
+        f"{record['goodput_pps']['closed']} pps | parity "
+        f"{parity_ok} common={len(common)} | rate={rate:.0f}pps "
+        f"({over_x}x of {capacity:.0f}) | artifact: {out_path}",
+        file=sys.stderr,
+    )
+    if not parity_ok:
+        sys.exit(4)
 
 
 def main_tpu_window():
@@ -3189,11 +3598,13 @@ if __name__ == "__main__":
         if idx >= len(argv):
             sys.exit("bench.py: --mode needs a value "
                      "(throughput|latency|farm|concurrent|overload|"
-                     "coldstart|obs-overhead|hotloop|tpu-window|"
-                     "mesh-scaling)")
+                     "coldstart|obs-overhead|hotloop|continuous|"
+                     "tpu-window|mesh-scaling)")
         mode = argv[idx]
     if mode == "latency":
         main_latency()
+    elif mode == "continuous":
+        main_continuous()
     elif mode == "farm":
         main_farm()
     elif mode == "concurrent":
@@ -3217,7 +3628,8 @@ if __name__ == "__main__":
     elif mode != "throughput":
         sys.exit(f"bench.py: unknown mode {mode!r} "
                  f"(throughput|latency|farm|concurrent|overload|coldstart|"
-                 f"obs-overhead|hotloop|tpu-window|mesh-scaling)")
+                 f"obs-overhead|hotloop|continuous|tpu-window|"
+                 f"mesh-scaling)")
     elif os.environ.get("BENCH_CHILD") == "1":
         main()
     else:

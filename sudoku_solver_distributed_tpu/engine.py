@@ -141,6 +141,25 @@ class SolverEngine:
         request dispatches immediately, strictly better latency than the
         fixed budget), the full budgets under load (full buckets). Off by
         default: fixed budgets, exactly the PR 1 behavior.
+      continuous: continuous batching (PR 12) — the coalesced serving
+        path runs the device loop OPEN-LOOP: bounded ``segment_iters``
+        -iteration segments over a fixed-width lane pool, finished lanes
+        resolved (futures answered) between segments and freshly admitted
+        boards injected into the freed slots on-device
+        (ops/solver.run_segment, parallel/coalescer.py). None (default)
+        resolves from ops.config.CONTINUOUS_SERVING — ON for the
+        coalesced xla bucket path; ``continuous=False`` (CLI
+        ``--no-continuous``) restores the closed-loop run-to-completion
+        dispatcher, the A/B arm of ``bench.py --mode continuous``.
+        Answers are bit-identical either way (segmenting is
+        schedule-independent, tests/test_continuous.py). Requires
+        ``coalesce=True`` and the xla backend; engines with a raw
+        ``sharding=`` but no mesh plane keep the closed loop.
+      segment_iters: lockstep iterations per continuous-batching segment
+        (the sweepable k — None resolves ops.config.SEGMENT per board
+        size). Smaller = finished lanes refill sooner (higher sustained
+        lane utilization, lower deadline-conditioned tails), larger
+        amortizes segment dispatch overhead.
       compile_cache_dir: root of the persistent compile plane
         (compilecache/): ``<dir>/xla`` hosts jax's persistent compilation
         cache (first-wins — an env/session-configured cache dir is never
@@ -195,6 +214,8 @@ class SolverEngine:
         coalesce_inflight_depth: int = 2,
         coalesce_max_batch: Optional[int] = None,
         coalesce_adaptive: bool = False,
+        continuous: Optional[bool] = None,
+        segment_iters: Optional[int] = None,
         compile_cache_dir: Optional[str] = None,
         aot_artifacts: bool = True,
         solver_config=None,
@@ -465,6 +486,45 @@ class SolverEngine:
         self.coalesce_inflight_depth = coalesce_inflight_depth
         self.coalesce_max_batch = coalesce_max_batch
         self.coalesce_adaptive = coalesce_adaptive
+        # Continuous batching (ISSUE 12): the open-loop segmented serving
+        # device loop — resolved here, program built below, driven by the
+        # coalescer's segment loop (parallel/coalescer.py).
+        from .ops.config import CONTINUOUS_SERVING, resolved_segment_shape
+
+        self.segment_shape = resolved_segment_shape(spec.size, segment_iters)
+        self.segment_iters = self.segment_shape["k"]
+        if continuous is None:
+            continuous = (
+                CONTINUOUS_SERVING["default_on"]
+                and coalesce
+                and backend == "xla"
+                # a raw sharding= without the mesh plane has no sharded
+                # segment program to dispatch through — keep closed-loop
+                and (sharding is None or self.mesh is not None)
+            )
+        elif continuous:
+            if backend != "xla":
+                raise ValueError(
+                    "continuous batching needs the xla backend — the "
+                    "pallas kernel bakes a static iteration bound and "
+                    "cannot carry resumable segment state"
+                )
+            if not coalesce:
+                raise ValueError(
+                    "continuous batching rides the coalesced serving "
+                    "path — it cannot be enabled with coalesce=False"
+                )
+            if sharding is not None and self.mesh is None:
+                # same reason the default resolution skips this shape: a
+                # raw placement has no sharded segment program, and the
+                # resumable pool state would silently ignore the caller's
+                # sharding — refuse rather than mislead
+                raise ValueError(
+                    "continuous batching with a raw sharding= needs the "
+                    "mesh plane (mesh=) — the lane-pool state has no "
+                    "sharded segment program to ride otherwise"
+                )
+        self.continuous = bool(continuous)
         self._coalescer = None
         self._coalescer_init_lock = threading.Lock()
         # Failure-domain supervision (ISSUE 5, serving/health.py): when an
@@ -704,6 +764,79 @@ class SolverEngine:
 
         self._solve_quick_state = jax.jit(_run_quick_state)
 
+        # Continuous-batching segment program (ISSUE 12): state-in /
+        # state-out, the segment budget a TRACED scalar (the PR 4 move),
+        # so every segment of every length shares ONE executable per pool
+        # width. Flat stack depth — segments resume mid-search, so the
+        # staged shallow/deep trick cannot apply (same collapse as the
+        # frontier racer).
+        self._depth_flat = depth_flat
+        if self.backend != "xla":
+            self._segment_program = None
+        elif self.mesh is not None:
+            from .parallel.shard import make_segment_serving_program
+
+            self._segment_program = make_segment_serving_program(
+                self.mesh,
+                self.spec,
+                max_depth=depth_flat,
+                locked_candidates=self.locked_candidates,
+                waves=self.waves,
+                naked_pairs=self.naked_pairs,
+                solver_overrides=tuple(
+                    sorted(self.solver_overrides.items())
+                ),
+            )
+        else:
+            def _run_segment_prog(state, boards, inject, seg_iters):
+                from .ops.solver import inject_lanes, run_segment
+
+                B = boards.shape[0]
+                waves_eff = 1 if B == 1 else self.waves
+                _packed, _legacy = self._loop_flavor()
+                state = inject_lanes(state, boards, inject, self.spec)
+                state, lstats = run_segment(
+                    state, seg_iters, self.spec,
+                    locked_candidates=self.locked_candidates,
+                    waves=waves_eff, naked_pairs=self.naked_pairs,
+                    packed=_packed, legacy_merges=_legacy,
+                )
+                # packed segment rows, one transfer per segment (the
+                # bucket-program contract plus a board_iters column):
+                # [grid | solved | status | guesses | validations |
+                #  board_iters | lane_steps | idle_lane_steps]
+                rows = jnp.concatenate(
+                    [
+                        state.grid,
+                        (state.status == SOLVED)[:, None].astype(jnp.int32),
+                        state.status[:, None],
+                        state.guesses[:, None],
+                        state.validations[:, None],
+                        state.board_iters[:, None],
+                        jnp.broadcast_to(lstats.lane_steps, (B,))[:, None],
+                        jnp.broadcast_to(
+                            lstats.idle_lane_steps, (B,)
+                        )[:, None],
+                    ],
+                    axis=1,
+                )
+                return state, rows
+
+            self._segment_program = jax.jit(_run_segment_prog)
+
+    @property
+    def continuous_active(self) -> bool:
+        """True when the coalesced path will ACTUALLY serve open-loop:
+        the flag is on AND a local segment program exists AND no
+        multi-host ``mesh_runner`` fan-out is wired (that path speaks the
+        closed-loop (boards, iters) protocol). The /metrics block and the
+        warmup plane key on this, not the bare flag."""
+        return (
+            self.continuous
+            and self._segment_program is not None
+            and self.mesh_runner is None
+        )
+
     @property
     def frontier_enabled(self) -> bool:
         """True when single-board solves route through the frontier race
@@ -737,6 +870,7 @@ class SolverEngine:
                         inflight_depth=self.coalesce_inflight_depth,
                         max_batch=self.coalesce_max_batch,
                         wait_policy=wait_policy,
+                        continuous=self.continuous,
                     )
         return self._coalescer
 
@@ -775,6 +909,16 @@ class SolverEngine:
             "frontier_fallbacks": self.frontier_fallbacks,
             "frontier_escalations": self.frontier_escalations,
             "coalesce": self.coalesce,
+            # the continuous-batching arm (ISSUE 12): which loop shape the
+            # coalesced path serves and its segment budget — the /metrics
+            # evidence an A/B (bench.py --mode continuous) keys on
+            "continuous": {
+                # the ACTIVE state, not the flag: a multi-host leader
+                # keeps the closed loop whatever the flag says
+                "enabled": self.continuous_active,
+                "configured": self.continuous,
+                "segment_iters": self.segment_iters,
+            },
             "warmed": self.warmed,
             "fully_warmed": self.fully_warmed,
             "warm": self.warm_info(),
@@ -838,14 +982,10 @@ class SolverEngine:
         at dispatch time is dropped and the call re-runs on the jit path
         (never a correctness risk)."""
         self._note_program("solve", grid.shape[0])
-        # only three budget values ever occur (normal / deep / quick):
-        # memoize their device scalars so the hot path never pays an
-        # extra host->device put per request (benign race: a double
-        # create stores the same value)
-        it = self._iter_scalars.get(iters)
-        if it is None:
-            it = jnp.int32(iters)
-            self._iter_scalars[iters] = it
+        # only a few budget values ever occur (normal / deep / quick /
+        # segment): memoize their device scalars so the hot path never
+        # pays an extra host->device put per request
+        it = self._iter_scalar(iters)
         exe = self._aot_execs.get(grid.shape[0])
         if exe is not None:
             try:
@@ -1172,6 +1312,155 @@ class SolverEngine:
             packed[capped, C + 3] += first[:, C + 3]
         return packed[:n]
 
+    # -- continuous-batching segment seam (ISSUE 12) -----------------------
+    def segment_pool_width(self) -> int:
+        """The lane-pool width the continuous serving loop runs at: the
+        bucket covering the coalescer's effective batch cap (mesh-rounded
+        by the ladder, so refill always respects the mesh-divisible
+        rounding)."""
+        cap = min(
+            self.coalesce_max_batch or self.buckets[-1], self.buckets[-1]
+        )
+        return self._bucket_for(cap)
+
+    def new_segment_pool(self, width: int):
+        """A fresh device-resident lane pool: every lane initialized from
+        an instantly-UNSAT pad board (dead after one sweep, then a free
+        slot). The pool state never round-trips to the host — segments
+        carry it device-to-device; only the packed rows are fetched."""
+        from .ops.solver import init_segment_state, pad_board
+
+        N = self.spec.size
+        boards = np.broadcast_to(
+            np.asarray(pad_board(self.spec)), (width, N, N)
+        )
+        return init_segment_state(
+            jnp.asarray(boards), self.spec, self._depth_flat
+        )
+
+    def run_segment_supervised(
+        self,
+        state,
+        boards: np.ndarray,
+        inject: np.ndarray,
+        *,
+        active: np.ndarray,
+        seg_iters: Optional[int] = None,
+        injected: Optional[int] = None,
+    ):
+        """One continuous-batching segment through THE supervised seam:
+        a watchdog token opens around the dispatch→fetch span (the PR 5
+        contract, same as ``_dispatch_padded``/``_finalize_padded``), the
+        engine-seam fault injector plugs in at the same two points, and
+        the segment's device wall / lane counters are stamped into
+        obs/cost.py — one locked append per SEGMENT, never per request.
+
+        ``active`` is the (width,) bool mask of lanes holding a live
+        request AFTER this boundary's injections — the fill/utilization
+        denominators, and which lanes count as "resolved" when terminal.
+
+        ``seg_iters`` overrides this segment's iteration budget (None →
+        the engine's configured k). The budget is a traced ARGUMENT of
+        the one compiled segment program, so the driver's geometric
+        escalation on all-deep pools costs zero compiles.
+
+        ``injected`` is the number of REAL requests boarding this
+        segment (the driver's refill count) — the cost plane's
+        ``injected`` gauge must reconcile with ``resolved``, so pad
+        re-seeds of abandoned lanes are excluded. None (library/test
+        callers) falls back to counting the mask.
+
+        Returns ``(state, rows, device_s)``: the carried-forward
+        device-resident pool state, the fetched (width, C+7) packed host
+        rows, and the segment's dispatch→fetch wall time (the riders'
+        per-segment device-stage stamp).
+        """
+        width = boards.shape[0]
+        sup = self.supervisor
+        token = sup.call_started(width) if sup is not None else None
+        t0 = time.monotonic()
+        try:
+            inj = self.fault_injector
+            if inj is not None:
+                inj.on_device_call(width)  # may raise (fail-next-N)
+            self._note_program("segment", width)
+            # callers may pass device-resident boards/inject (the driver
+            # caches the idle no-injection pair): converting 2 KB of numpy
+            # per segment costs more than the whole segment fetch at CPU
+            # serving widths, so skip it when already placed
+            if not isinstance(boards, jax.Array):
+                boards = self._device_batch(boards)
+            if isinstance(inject, jax.Array):
+                inject_dev = inject
+                if injected is None:
+                    # count injections from a settled host copy — an
+                    # eight-int fetch of a mask host-built moments ago
+                    injected = int(
+                        np.asarray(jax.block_until_ready(inject_dev))
+                        .astype(bool).sum()
+                    )
+            else:
+                inject_np = np.asarray(inject)
+                if injected is None:
+                    injected = int(inject_np.astype(bool).sum())
+                inject_dev = jnp.asarray(inject_np, jnp.int32)
+            state, packed = self._segment_program(
+                state,
+                boards,
+                inject_dev,
+                self._iter_scalar(
+                    int(seg_iters) if seg_iters else self.segment_iters
+                ),
+            )
+            if self.mesh is not None:
+                from .parallel.shard import split_evidence
+
+                split = split_evidence(packed)
+                with self._lock:
+                    self.mesh_dispatches += 1
+                    self._mesh_last_split = split
+                    ndev = split.get("devices", 1)
+                    if (
+                        self._mesh_min_devices is None
+                        or ndev < self._mesh_min_devices
+                    ):
+                        self._mesh_min_devices = ndev
+            if inj is not None:
+                inj.on_fetch(width)  # may sleep (watchdog food)
+            rows = np.array(jax.block_until_ready(packed))
+            if inj is not None:
+                rows = inj.corrupt(width, rows)
+        except BaseException:
+            if sup is not None:
+                sup.call_finished(token, ok=False)
+            raise
+        if sup is not None:
+            sup.call_finished(token, ok=True)
+        device_s = time.monotonic() - t0
+        C = self.spec.cells
+        act = np.asarray(active, bool)
+        self.cost.note_segment(
+            width=width,
+            active=int(act.sum()),
+            injected=int(injected),
+            resolved=int(((rows[:, C + 1] != RUNNING) & act).sum()),
+            device_s=device_s,
+            lane_steps=int(rows[0, C + 5]) if rows.shape[1] > C + 5 else 0,
+            idle_lane_steps=(
+                int(rows[0, C + 6]) if rows.shape[1] > C + 6 else 0
+            ),
+        )
+        return state, rows, device_s
+
+    def _iter_scalar(self, iters: int):
+        """Memoized device scalar for a traced iteration budget (shared
+        with ``_exec`` — benign double-create race stores equal values)."""
+        it = self._iter_scalars.get(iters)
+        if it is None:
+            it = jnp.int32(iters)
+            self._iter_scalars[iters] = it
+        return it
+
     def _solve_padded(self, boards: np.ndarray) -> np.ndarray:
         """Solve ≤bucket boards, padding with duplicates of the first row.
 
@@ -1201,10 +1490,12 @@ class SolverEngine:
             self.validations += int(rows[:, C + 3].sum())
             self.solved_puzzles += int(rows[:, C].sum())
 
-    def _row_result(self, row: np.ndarray):
+    def _row_result(self, row: np.ndarray, routed: str = "coalesced"):
         """One packed host row → the (solution | None, info) contract of
         ``solve_one``. ``capped`` keeps the not-finished ≠ proven-UNSAT
-        distinction (the deep retry already ran in _finalize_padded)."""
+        distinction (the deep retry already ran in _finalize_padded; on
+        the continuous path the segment driver runs it before resolving
+        and passes ``routed='continuous'``)."""
         C = self.spec.cells
         N = self.spec.size
         solved = bool(row[C])
@@ -1212,7 +1503,7 @@ class SolverEngine:
             "validations": int(row[C + 3]),
             "guesses": int(row[C + 2]),
             "capped": int(row[C + 1] == RUNNING),
-            "routed": "coalesced",
+            "routed": routed,
         }
         solution = row[:C].reshape(N, N).tolist() if solved else None
         return solution, info
@@ -1274,6 +1565,7 @@ class SolverEngine:
                 for b in self._tier0_buckets():
                     self._warm_bucket(b)
                 self._warm_probe_programs()
+                self._warm_segment_program()
         finally:
             if trace_warm:
                 self._profile_mutex.release()
@@ -1336,6 +1628,27 @@ class SolverEngine:
                 )
             )
 
+    def _warm_segment_program(self) -> None:
+        """Tier-0 companion for the continuous serving loop (ISSUE 12):
+        compile the segment program at the pool width before serving —
+        the first /solve must never pay its trace, and the supervisor's
+        LOST-rebuild warmup re-proves it the same way. One trivial
+        segment over an all-pad pool (instantly-UNSAT lanes, dead in one
+        sweep) is the whole cost."""
+        if not self.continuous_active:
+            return
+        w = self.segment_pool_width()
+        N = self.spec.size
+        state = self.new_segment_pool(w)
+        self._note_program("segment", w)
+        _state, packed = self._segment_program(
+            state,
+            self._device_batch(np.zeros((w, N, N), np.int32)),
+            jnp.zeros((w,), jnp.int32),
+            self._iter_scalar(self.segment_iters),
+        )
+        jax.block_until_ready(packed)
+
     def _warm_bucket(self, b: int) -> None:
         """Compile (or AOT-load) the width-``b`` bucket program and record
         it warm. Idempotent. The AOT path never raises — trace-and-compile
@@ -1396,6 +1709,14 @@ class SolverEngine:
             cfg["solver_loop"] = dict(
                 sorted(self.solver_loop_info().items())
             )
+            # the resolved continuous-batching arm (ISSUE 12): artifacts
+            # baked by the open-loop serving plane must never load into a
+            # closed-loop (--no-continuous) engine or across segment
+            # shapes — an A/B would silently serve the wrong arm's plane
+            cfg["segment"] = {
+                "continuous": self.continuous,
+                **self.segment_shape,
+            }
         if self.mesh is not None:
             # the mesh SHAPE and sharding spec are trace constants of the
             # shard_map program: a 4-way split is a different program than
@@ -1752,6 +2073,71 @@ class SolverEngine:
             "capped": capped,
         }
 
+    def solve_batch_np_supervised(
+        self, boards: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, dict]:
+        """``solve_batch_np`` under the degraded-serving contract (ISSUE
+        12 satellite — closing the PR 5 known limit on ``/solve_batch``):
+        with a supervisor attached, an OPEN breaker routes every board
+        through the supervised host-oracle fallback (bounded concurrency
+        + per-board budget, serving/health.py) and a device failure
+        mid-batch falls back the same way — the batch answers
+        degraded-mode boards instead of a whole-batch error, exactly as
+        the single-board path has since PR 5.
+
+        ``info`` gains ``degraded_boards`` (per-board bools; the HTTP
+        layer's body flags) and ``degraded`` (any-board summary → the
+        ``X-Degraded`` response header). Without a supervisor this is
+        byte-identical to ``solve_batch_np``.
+        """
+        boards = np.asarray(boards, np.int32)
+        B = boards.shape[0]
+        sup = self.supervisor
+        if sup is None:
+            return self.solve_batch_np(boards)
+        if not sup.should_fallback():
+            try:
+                sols, mask, info = self.solve_batch_np(boards)
+            except Exception:  # noqa: BLE001 — the seam already fed the breaker
+                logger.exception(
+                    "batch device path failed — answering per board from "
+                    "the supervised oracle fallback"
+                )
+                return self._fallback_batch(sup, boards)
+            info["degraded_boards"] = [False] * B
+            info["degraded"] = False
+            return sols, mask, info
+        return self._fallback_batch(sup, boards)
+
+    def _fallback_batch(self, sup, boards: np.ndarray):
+        """Answer a whole batch from the supervised host oracle, board by
+        board (bounded by the fallback semaphore; a board that trips the
+        per-solve budget stays unsolved and counts as capped — "not
+        finished", never a whole-batch 500)."""
+        B = boards.shape[0]
+        solutions = boards.copy()
+        mask = np.zeros((B,), bool)
+        capped = 0
+        for i in range(B):
+            try:
+                sol, _info = sup.fallback_solve(boards[i])
+            except Exception:  # noqa: BLE001 — budget trip or oracle failure
+                capped += 1
+                continue
+            if sol is not None:
+                solutions[i] = np.asarray(sol, np.int32)
+                mask[i] = True
+        with self._lock:
+            self.solved_puzzles += int(mask.sum())
+        return solutions, mask, {
+            "validations": 0,
+            "guesses": 0,
+            "capped": capped,
+            "degraded_boards": [True] * B,
+            "degraded": True,
+            "routed": "oracle-fallback",
+        }
+
     def _probe_quick(self, arr: np.ndarray):
         """Auto-route probe: one bucket-1 pass at ``frontier_escalate_iters``.
 
@@ -1870,9 +2256,17 @@ class SolverEngine:
         )
         return "done", (solution, info)
 
-    def _frontier_raw(self, arr: np.ndarray, seed_states=None):
+    def _frontier_raw(self, arr: np.ndarray, seed_states=None, deadline_s=None):
         """Run the race without serving-stats side effects; _frontier_solve
-        wraps it with the counter accounting."""
+        wraps it with the counter accounting.
+
+        Deadline scope: the LOCAL race honors ``deadline_s`` at its
+        seeding round boundaries and before dispatch (ISSUE 12). The
+        multi-host ``frontier_runner`` path gets only the escalation-
+        boundary check in ``solve_one`` — the serving loop's broadcast
+        wire carries a bare board, so a deadline cannot follow the
+        request across hosts yet (known limit; the round-trip is bounded
+        by the loop's own timeout either way)."""
         if self.frontier_runner is not None:
             # multi-host race: the loop's round-trip IS this request's
             # device stage (the local branch is stamped finer inside
@@ -1900,11 +2294,12 @@ class SolverEngine:
                 packed=packed,
                 legacy_merges=legacy,
                 initial_states=seed_states,
+                deadline_s=deadline_s,
             )
         return solution, dict(info, frontier=True)
 
-    def _frontier_solve(self, arr: np.ndarray, seed_states=None):
-        solution, info = self._frontier_raw(arr, seed_states)
+    def _frontier_solve(self, arr: np.ndarray, seed_states=None, deadline_s=None):
+        solution, info = self._frontier_raw(arr, seed_states, deadline_s)
         with self._lock:
             self.validations += info["validations"]
             if solution is not None:
@@ -1975,13 +2370,23 @@ class SolverEngine:
         board: Sequence[Sequence[int]],
         *,
         frontier: Optional[bool] = None,
+        deadline_s: Optional[float] = None,
     ) -> Tuple[Optional[List[List[int]]], dict]:
         """Solve a single board; returns (solution | None, info).
 
         With ``frontier_mesh`` configured, requests run the mesh-sharded
         subtree race instead of a bucket-1 batch solve. ``frontier=False``
         forces the bucket path for a single call — the P2P worker's per-cell
-        tasks use it so farmed cells never occupy the whole mesh."""
+        tasks use it so farmed cells never occupy the whole mesh.
+
+        ``deadline_s`` (absolute monotonic, the admission budget — ISSUE
+        12 satellite): frontier-routed requests now honor it across the
+        escalation leg, the contract the farm path got in PR 5 — a
+        request that expires after its probe but before the race, or
+        mid-seeding, raises ``DeadlineExceeded`` (the 429 path) instead
+        of occupying the whole mesh for an answer nobody is waiting for.
+        A race already dispatched runs to completion (service time paid
+        is never thrown away)."""
         arr = np.asarray(board, np.int32)
         use_frontier = (
             self.frontier_enabled
@@ -2011,8 +2416,19 @@ class SolverEngine:
                 if probed is not None:
                     return probed
         if use_frontier:
+            from .serving.admission import DeadlineExceeded
+
+            if deadline_s is not None and time.monotonic() > deadline_s:
+                # the escalation boundary: the probe's device time is
+                # already paid, but the race leg has not started — an
+                # expired request cancels it and answers 429
+                raise DeadlineExceeded(
+                    "deadline expired before the frontier race"
+                )
             try:
-                solution, info = self._frontier_solve(arr, seed_states)
+                solution, info = self._frontier_solve(
+                    arr, seed_states, deadline_s
+                )
                 if solution is None and info.get("capped"):
                     # same contract as the bucket path below: a race whose
                     # every subtree OVERFLOWed or was still RUNNING at
@@ -2025,6 +2441,11 @@ class SolverEngine:
                         "board not finished, NOT proven unsolvable"
                     )
                 return solution, info
+            except DeadlineExceeded:
+                # a shed request must stay shed: expiry mid-escalation is
+                # the 429 path, never a bucket-path downgrade that would
+                # serve (and bill) an answer nobody is waiting for
+                raise
             except Exception:  # noqa: BLE001 — any race failure
                 # A dead/failed frontier path (e.g. a failed collective
                 # stopping the multi-host serving loop) must not take
@@ -2190,7 +2611,11 @@ class SolverEngine:
                 raise DeadlineExceeded(
                     "deadline expired before the solve started"
                 )
-            fut.set_result(self.solve_one(board, frontier=frontier))
+            fut.set_result(
+                self.solve_one(
+                    board, frontier=frontier, deadline_s=deadline_s
+                )
+            )
         except BaseException as e:  # noqa: BLE001 — deliver through the future
             fut.set_exception(e)
         return fut
@@ -2246,4 +2671,4 @@ class SolverEngine:
             raise DeadlineExceeded(
                 "deadline expired before the solve started"
             )
-        return self.solve_one(board)
+        return self.solve_one(board, deadline_s=deadline_s)

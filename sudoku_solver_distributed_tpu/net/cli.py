@@ -32,6 +32,12 @@ Extensions (defaults preserve reference behavior):
                 /solve requests into one bucketed device call; max-batch
                 caps boards per call at the backend's efficient width
                 (8 on the CPU fallback — engine.py rationale)
+  --no-continuous / --segment-iters
+                continuous batching (PR 12, default ON): the coalesced
+                path runs bounded k-iteration device segments over a lane
+                pool, resolving finished lanes and injecting fresh boards
+                mid-flight; --no-continuous restores the closed-loop
+                dispatcher (A/B arm), --segment-iters sweeps k
   --profile-dir write a jax.profiler device trace of each /solve to this dir
   --failure-timeout
                 seconds of neighbor silence before a crash is declared (the
@@ -302,6 +308,25 @@ def build_parser() -> argparse.ArgumentParser:
         "board's iterations across the full width (engine.py rationale)",
     )
     parser.add_argument(
+        "--no-continuous",
+        action="store_true",
+        help="disable continuous batching: the coalesced serving path "
+        "falls back to the closed-loop run-to-completion dispatcher "
+        "instead of the open-loop segmented lane pool with mid-flight "
+        "refill (parallel/coalescer.py; the A/B escape hatch of "
+        "bench.py --mode continuous). Answers are bit-identical either "
+        "way",
+    )
+    parser.add_argument(
+        "--segment-iters",
+        type=int,
+        default=None,
+        help="lockstep iterations per continuous-batching segment (the "
+        "sweepable k; default: ops.config.SEGMENT per board size). "
+        "Smaller = finished lanes refill sooner, larger amortizes "
+        "segment dispatch overhead",
+    )
+    parser.add_argument(
         "--compile-cache-dir",
         default=os.environ.get("SUDOKU_COMPILE_CACHE_DIR") or None,
         help="root of the persistent compile plane (compilecache/): "
@@ -485,6 +510,11 @@ def main(argv=None) -> None:
         "coalesce_max_wait_s": args.coalesce_max_wait_ms / 1e3,
         "coalesce_max_batch": args.coalesce_max_batch,
         "coalesce_adaptive": args.adaptive_coalesce,
+        # continuous batching (ISSUE 12): default ON for the coalesced
+        # path (None resolves ops.config.CONTINUOUS_SERVING); the flag
+        # is the closed-loop A/B escape hatch
+        "continuous": False if args.no_continuous else None,
+        "segment_iters": args.segment_iters,
         "compile_cache_dir": args.compile_cache_dir,
         "solver_config": args.solver_config,
     }

@@ -382,11 +382,11 @@ class FastHTTPServer:
                 )
                 return status, payload, False, degraded
             if path == "/solve_batch" and self.expose_batch:
-                status, payload, error = http_api.solve_batch_route(
-                    node, body
+                status, payload, error, degraded = (
+                    http_api.solve_batch_route(node, body)
                 )
                 self._record("/solve_batch", t0, error=error)
-                return status, payload, False, False
+                return status, payload, False, degraded
             if (
                 path == "/debug/flightrecord"
                 and getattr(node, "flight", None) is not None

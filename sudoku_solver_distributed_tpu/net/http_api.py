@@ -119,6 +119,9 @@ def timing_header_value(record: dict) -> str:
             "degraded": record["degraded"],
             "fallback": record["fallback"],
             "farmed": record["farmed"],
+            # continuous batching (ISSUE 12): how many device segments
+            # this request's device span covered (0 on the closed loop)
+            "segments": record["segments"],
         },
         separators=(",", ":"),
     )
@@ -272,11 +275,19 @@ def solve_batch_route(p2p_node, body: bytes):
     of one board per request. Body: {"sudokus": [grid, ...]} →
     {"solutions": [grid|null, ...], "solved": n, "capped": n}. null rows
     mean not solved; capped counts rows whose search exhausted the
-    iteration budget (not finished ≠ proven unsatisfiable, engine.py)."""
+    iteration budget (not finished ≠ proven unsatisfiable, engine.py).
+
+    Returns ``(status, payload, error_flag, degraded)`` like
+    ``solve_route`` (ISSUE 12 satellite — the PR 5 known limit closed):
+    under an open breaker or a mid-batch device failure the supervised
+    engine answers every board from the host-oracle fallback; the reply
+    then carries per-board ``degraded`` flags in the body and transports
+    surface the any-board summary as ``X-Degraded``, instead of the
+    whole batch erroring."""
     try:
         sudokus = json.loads(body.decode())["sudokus"]
     except (ValueError, KeyError, TypeError, UnicodeDecodeError):
-        return 400, {"error": "Invalid request"}, True
+        return 400, {"error": "Invalid request"}, True, False
     size = p2p_node.engine.spec.size
     if not isinstance(sudokus, list) or not 1 <= len(sudokus) <= MAX_BATCH:
         reason = f"need 1..{MAX_BATCH} boards"
@@ -286,20 +297,22 @@ def solve_batch_route(p2p_node, body: bytes):
         )
     if reason is not None:
         logger.info("rejected /solve_batch body: %s", reason)
-        return 400, {"error": "Invalid request"}, True
+        return 400, {"error": "Invalid request"}, True, False
     solutions, mask, info = p2p_node.batch_sudoku_solve(sudokus)
-    return (
-        200,
-        {
-            "solutions": [
-                sol.tolist() if ok else None
-                for sol, ok in zip(solutions, mask)
-            ],
-            "solved": int(mask.sum()),
-            "capped": info["capped"],
-        },
-        False,
-    )
+    payload = {
+        "solutions": [
+            sol.tolist() if ok else None
+            for sol, ok in zip(solutions, mask)
+        ],
+        "solved": int(mask.sum()),
+        "capped": info["capped"],
+    }
+    degraded = bool(info.get("degraded"))
+    if degraded:
+        # per-board flags only when fallback serving actually happened:
+        # the healthy-path body stays byte-identical to the pre-PR12 one
+        payload["degraded"] = [bool(d) for d in info["degraded_boards"]]
+    return 200, payload, False, degraded
 
 
 def healthz_payload(p2p_node):
@@ -640,16 +653,18 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
                 self.p2p_node, "/solve_batch", self._req_id
             )
             try:
-                status, payload, error = solve_batch_route(
+                status, payload, error, degraded = solve_batch_route(
                     self.p2p_node, post_data
                 )
             except BaseException:
                 finish_trace(self.p2p_node, trace, 500)
                 raise
-            record = finish_trace(self.p2p_node, trace, status)
+            record = finish_trace(
+                self.p2p_node, trace, status, degraded=degraded
+            )
             self._record("/solve_batch", t0, error=error)
             self._send_response(
-                payload, status,
+                payload, status, degraded=degraded,
                 timing=timing_header_value(record)
                 if record is not None and self._want_timing
                 else None,

@@ -561,6 +561,14 @@ class P2PNode:
             value = solution[row][col] if solution is not None else None
             if value is None:
                 status = 400
+            # close the span BEFORE the reply datagram: the solution
+            # message is the task's observable completion, and a master
+            # (or a test) acting on it must find the farm-task span
+            # already in the ring — finishing after send_to raced that
+            # read (the send itself is ~µs, not worth a span stage)
+            if tracer is not None:
+                tracer.finish(wtrace, status)
+                wtrace = None
             self.send_to(
                 origin,
                 wire.solution_msg(
@@ -572,7 +580,9 @@ class P2PNode:
             raise
         finally:
             self._current_task = None
-            if tracer is not None:
+            if tracer is not None and wtrace is not None:
+                # the exception path's backstop — the success path
+                # already finished (and cleared) the span above
                 tracer.finish(wtrace, status)
         self.broadcast_stats()  # same trigger as reference node.py:406
 
@@ -659,8 +669,12 @@ class P2PNode:
         its validation sweeps, and one stats broadcast follows."""
         # solve_batch_np is thread-safe (engine-internal counter lock); the
         # node-side counter shares _state_lock with the engine-path solves
-        # now that /solve requests no longer serialize behind _solve_lock
-        solutions, mask, info = self.engine.solve_batch_np(sudokus)
+        # now that /solve requests no longer serialize behind _solve_lock.
+        # The supervised wrapper (ISSUE 12 satellite) answers degraded-mode
+        # boards from the host-oracle fallback under an open breaker or a
+        # device failure, instead of erroring the whole batch — the same
+        # contract /solve has had since PR 5.
+        solutions, mask, info = self.engine.solve_batch_np_supervised(sudokus)
         with self._state_lock:
             self._solved_count += int(mask.sum())
         self.broadcast_stats()

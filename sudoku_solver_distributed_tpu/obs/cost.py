@@ -85,6 +85,20 @@ class CostAccounting:
         # and the realized fill — the latency the batching layer itself
         # adds, next to the device time it buys
         self._formation: deque = deque(maxlen=window)
+        # continuous-batching segment samples (ISSUE 12): one per
+        # dispatched segment — (t, device_s, active, width, injected,
+        # resolved, lane_steps, idle_lane_steps). The recent ring feeds
+        # the SUSTAINED lane-utilization gauge the open-loop acceptance
+        # reads; the cumulative dict feeds the lifetime view.
+        self._segments: deque = deque(maxlen=window)
+        self._seg_totals = {
+            "segments": 0,
+            "injected": 0,
+            "resolved": 0,
+            "device_s": 0.0,
+            "lane_steps": 0,
+            "idle_lane_steps": 0,
+        }
 
     def record_call(
         self,
@@ -122,6 +136,57 @@ class CostAccounting:
         the realized fill (parallel/coalescer.py dispatcher)."""
         with self._lock:
             self._formation.append((max(0.0, wait_s), fill))
+
+    def note_segment(
+        self,
+        *,
+        width: int,
+        active: int,
+        injected: int,
+        resolved: int,
+        device_s: float,
+        lane_steps: int = 0,
+        idle_lane_steps: int = 0,
+    ) -> None:
+        """One continuous-batching segment finalized (ISSUE 12,
+        engine.run_segment_supervised): lane-pool width, lanes carrying a
+        live request, boards injected/resolved this boundary, and the
+        segment's LoopStats. One locked append per SEGMENT.
+
+        A segment IS a device call at the pool width, so it folds into
+        the same per-bucket ledger as a closed dispatch — ``boards`` are
+        the requests RESOLVED at this boundary (so bucket pps stays
+        boards-answered-per-device-second), lanes without a live request
+        bill as coalescer pad. The ``engine.cost`` headline totals and
+        per-bucket breakdown therefore read identically across the
+        closed/continuous arms; the ``continuous`` block adds the
+        open-loop-only sustained gauges on top."""
+        if device_s < 0.0:
+            device_s = 0.0
+        with self._lock:
+            b = self._buckets.get(width)
+            if b is None:
+                b = self._buckets[width] = _BucketCost(self._window)
+            b.dispatches += 1
+            b.boards += resolved
+            b.pad_coalesce += max(0, width - active)
+            b.device_s += device_s
+            b.lane_steps += lane_steps
+            b.idle_lane_steps += idle_lane_steps
+            b.recent.append((time.monotonic(), device_s, resolved))
+            t = self._seg_totals
+            t["segments"] += 1
+            t["injected"] += injected
+            t["resolved"] += resolved
+            t["device_s"] += device_s
+            t["lane_steps"] += lane_steps
+            t["idle_lane_steps"] += idle_lane_steps
+            self._segments.append(
+                (
+                    time.monotonic(), device_s, active, width, injected,
+                    resolved, lane_steps, idle_lane_steps,
+                )
+            )
 
     # -- reporting -----------------------------------------------------------
     def _bucket_entry(self, width: int, b: _BucketCost, now: float) -> dict:
@@ -169,6 +234,8 @@ class CostAccounting:
             lane_steps = sum(b.lane_steps for b in self._buckets.values())
             idle = sum(b.idle_lane_steps for b in self._buckets.values())
             formation = list(self._formation)
+            seg_totals = dict(self._seg_totals)
+            segments = list(self._segments)
         out = {
             "dispatches": dispatches,
             "boards": boards,
@@ -179,8 +246,44 @@ class CostAccounting:
             "pad_mesh_pct": _pct(pad_m, lanes),
             "pad_waste_pct": _pct(pad_c + pad_m, lanes),
             "lane_util_pct": _pct(lane_steps - idle, lane_steps),
+            # raw loop-work totals (bucket + segment planes): windowed
+            # deltas of these ARE the sustained-utilization measurement
+            # (bench.py --mode continuous)
+            "lane_steps": lane_steps,
+            "idle_lane_steps": idle,
             "buckets": per_bucket,
         }
+        if seg_totals["segments"]:
+            # the continuous-batching block (ISSUE 12): lifetime totals +
+            # the SUSTAINED recent-window gauges — utilization and
+            # resolved-board throughput over the last recent_horizon_s of
+            # segments, the "is refill actually keeping lanes busy right
+            # now" number the open-loop bench reads
+            rec = [s for s in segments if now - s[0] <= self.recent_horizon_s]
+            rec_lane = sum(s[6] for s in rec)
+            rec_idle = sum(s[7] for s in rec)
+            rec_dev = sum(s[1] for s in rec)
+            rec_resolved = sum(s[5] for s in rec)
+            rec_occ = sum(s[2] for s in rec)
+            rec_slots = sum(s[3] for s in rec)
+            out["continuous"] = {
+                "segments": seg_totals["segments"],
+                "injected": seg_totals["injected"],
+                "resolved": seg_totals["resolved"],
+                "device_s": round(seg_totals["device_s"], 4),
+                "lane_util_pct": _pct(
+                    seg_totals["lane_steps"] - seg_totals["idle_lane_steps"],
+                    seg_totals["lane_steps"],
+                ),
+                "sustained_lane_util_pct": _pct(
+                    rec_lane - rec_idle, rec_lane
+                ),
+                "sustained_pps": (
+                    round(rec_resolved / rec_dev, 1) if rec_dev else 0.0
+                ),
+                "sustained_occupancy_pct": _pct(rec_occ, rec_slots),
+                "recent_segments": len(rec),
+            }
         if formation:
             out["formation"] = {
                 "batches": len(formation),

@@ -60,7 +60,7 @@ STAGES = ("queue", "coalesce", "device", "verify", "fallback")
 RECORD_FIELDS = (
     "trace_id", "route", "t", "status", "total_ms",
     "queue_ms", "coalesce_ms", "device_ms", "verify_ms", "fallback_ms",
-    "bucket", "batch_id", "degraded", "fallback", "farmed",
+    "bucket", "batch_id", "degraded", "fallback", "farmed", "segments",
 )
 
 _ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,64}$")
@@ -115,7 +115,7 @@ class RequestTrace:
 
     __slots__ = (
         "trace_id", "route", "t0", "t_wall", "stages",
-        "bucket", "batch_id", "degraded", "fallback", "farmed",
+        "bucket", "batch_id", "degraded", "fallback", "farmed", "segments",
     )
 
     def __init__(self, trace_id: str, route: str):
@@ -128,7 +128,12 @@ class RequestTrace:
         self.batch_id: Optional[int] = None
         self.degraded = False
         self.fallback = False
+        # continuous-batching segments this request's device stage spans
+        # (ISSUE 12): the coalescer's segment driver increments it per
+        # boundary and device_ms accumulates across them (mark() sums),
+        # so one request's device span legitimately covers many segments
         self.farmed = False
+        self.segments = 0
 
     def mark(self, stage: str, seconds: float) -> None:
         """Accumulate stage time (a /solve_batch span sums its chunks'
@@ -223,6 +228,7 @@ class Tracer:
         record["degraded"] = trace.degraded
         record["fallback"] = trace.fallback
         record["farmed"] = trace.farmed
+        record["segments"] = trace.segments
         self.stages.observe_span(stages, total_s)
         self.finished += 1  # benign race (see __init__)
         if self.recorder is not None:

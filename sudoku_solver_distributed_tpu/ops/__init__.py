@@ -17,8 +17,20 @@ from .validate import (
     is_valid_move,
 )
 from .propagate import propagate, propagate_step
-from .solver import solve_batch, SolveResult
-from .config import SERVING_CONFIG, cpu_serving_config, serving_config
+from .solver import (
+    SegmentState,
+    SolveResult,
+    init_segment_state,
+    inject_lanes,
+    run_segment,
+    solve_batch,
+)
+from .config import (
+    SERVING_CONFIG,
+    cpu_serving_config,
+    segment_config,
+    serving_config,
+)
 
 __all__ = [
     "BoardSpec",
@@ -41,7 +53,12 @@ __all__ = [
     "propagate_step",
     "solve_batch",
     "SolveResult",
+    "SegmentState",
+    "init_segment_state",
+    "inject_lanes",
+    "run_segment",
     "SERVING_CONFIG",
     "serving_config",
     "cpu_serving_config",
+    "segment_config",
 ]

@@ -194,6 +194,58 @@ def resolve_solver_overrides(config) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Continuous batching (PR 12): the open-loop segmented serving device loop.
+#
+# ``k`` is the SEGMENT iteration budget: the continuous serving loop runs
+# the lockstep solver in bounded k-iteration segments, and between segments
+# the coalescer compacts finished lanes out (their futures resolve
+# immediately, not at batch end) and injects freshly admitted boards into
+# the freed slots (ops/solver.run_segment, parallel/coalescer.py).
+# Smaller k = finished lanes are evicted and refilled sooner (higher
+# sustained lane utilization, lower deadline-conditioned tail latency)
+# but more host round trips per solve; larger k amortizes the
+# dispatch/fetch overhead. Sweepable per engine (``segment_iters=`` /
+# ``--segment-iters``).
+#
+# Measured (2026-08-04, pinned CPU core, bench.py --mode continuous
+# smoke grid at 2x overload, mixed easy/deep): 9x9 k=8 is the clear
+# winner — sustained lane-util ratio 1.31-1.32x vs closed-loop and the
+# deadline-conditioned p99 ~40% lower, vs 1.20x at k=12, 1.08-1.10x at
+# k=16, ~1.02x at k=32/64 (an easy 9x9 solves in ~8 lockstep iterations,
+# so k=8 refills a freed lane after at most one easy-solve's worth of
+# idling). 16x16/25x25 scale k with their heavier per-iteration sweeps;
+# unmeasured — a TPU-window sweep owns the on-chip values (ROADMAP).
+SEGMENT = {
+    9: dict(k=8),
+    16: dict(k=16),
+    25: dict(k=32),
+}
+_SEGMENT_DEFAULT = dict(k=16)
+
+# The continuous-batching serving default (PR 12): on for the coalesced
+# bucket path (the vLLM/Orca-style iteration-level scheduling move);
+# ``--no-continuous`` / SolverEngine(continuous=False) is the A/B escape
+# hatch that restores the closed-loop run-to-completion dispatcher.
+CONTINUOUS_SERVING = dict(default_on=True)
+
+
+def segment_config(size: int) -> dict:
+    """Measured-default segment shape for an N×N board."""
+    return dict(SEGMENT.get(size, _SEGMENT_DEFAULT))
+
+
+def resolved_segment_shape(size: int, segment_iters=None) -> dict:
+    """The segment shape the continuous serving loop will actually run —
+    the single resolution site shared by the engine's segment programs,
+    its AOT artifact key (engine._program_config), and /metrics exposure,
+    the same contract as resolved_loop_shape below."""
+    k = segment_iters if segment_iters is not None else segment_config(size)["k"]
+    if int(k) < 1:
+        raise ValueError(f"segment_iters must be >= 1, got {k}")
+    return {"k": int(k)}
+
+
+# ---------------------------------------------------------------------------
 # Mesh serving policy (PR 8): the data-parallel bucket plane.
 #
 # ``auto_min_devices`` — the device count at which ``SolverEngine(mesh=

@@ -646,6 +646,172 @@ def _run_compacted(
     return _put_boards(state, sub, idx), stats
 
 
+# ---------------------------------------------------------------------------
+# Segment entry/exit contract (PR 12 — continuous batching).
+#
+# The serving loop's open-loop form: instead of running a dispatch to
+# completion, the device executes bounded k-iteration SEGMENTS over a
+# fixed-width lane pool, carrying the full resumable solver state across
+# segment boundaries on-device. Between segments the host resolves
+# finished lanes immediately and injects freshly admitted boards into the
+# freed slots — the injection is a one-hot masked row merge inside the
+# same compiled program, never a host round trip of the whole batch.
+#
+# Schedule independence (the correctness bar, same property as the PR 7
+# compaction): ``_step`` is elementwise over the board axis — a board's
+# grid, status, guesses, and validations after m applications of the step
+# depend only on its own row — and a terminal-status row is a fixed point
+# of ``_step``. So a board's trajectory and per-board counters are
+# bit-identical whether it ran in one flat dispatch or across any number
+# of segments with strangers rotating through the other lanes
+# (tests/test_continuous.py pins this).
+
+
+class SegmentState(NamedTuple):
+    """Resumable per-lane solver state carried across segment boundaries.
+
+    The per-board fields of ``_State`` plus ``board_iters`` — the number
+    of lockstep steps each lane has executed while RUNNING since its
+    injection. The batch-shared ``iters`` scalar of the closed loop is
+    meaningless once lanes enter mid-flight, so the iteration budget
+    (``max_iters`` cap → deep-retry eviction) is enforced per lane by the
+    segment driver from this counter.
+    """
+
+    grid: jnp.ndarray         # (B, C) int32
+    stack_grid: jnp.ndarray   # (B, D, C) int8
+    stack_cell: jnp.ndarray   # (B, D) int32
+    stack_mask: jnp.ndarray   # (B, D) int32
+    depth: jnp.ndarray        # (B,) int32
+    status: jnp.ndarray       # (B,) int32
+    guesses: jnp.ndarray      # (B,) int32
+    validations: jnp.ndarray  # (B,) int32
+    board_iters: jnp.ndarray  # (B,) int32
+
+
+def init_segment_state(
+    grid: jnp.ndarray, spec: BoardSpec, max_depth: int | None = None
+) -> SegmentState:
+    """Fresh lane-pool state for a (B, N, N) batch. ``max_depth`` must be
+    a FLAT int (a staged tuple collapses at the engine: segments resume
+    mid-search, so only the full-depth guarantee is meaningful — the same
+    collapse the frontier racer applies)."""
+    if isinstance(max_depth, (tuple, list)):
+        max_depth = max(max_depth)
+    st = init_state(grid, spec, max_depth)
+    return SegmentState(
+        grid=st.grid,
+        stack_grid=st.stack_grid,
+        stack_cell=st.stack_cell,
+        stack_mask=st.stack_mask,
+        depth=st.depth,
+        status=st.status,
+        guesses=st.guesses,
+        validations=st.validations,
+        board_iters=jnp.zeros_like(st.guesses),
+    )
+
+
+def inject_lanes(
+    state: SegmentState,
+    boards: jnp.ndarray,
+    inject: jnp.ndarray,
+    spec: BoardSpec,
+) -> SegmentState:
+    """Merge freshly admitted boards into the masked lanes: rows where
+    ``inject`` is nonzero are re-initialized from the matching ``boards``
+    row (a one-hot masked row merge — jnp.where over every state field);
+    all other lanes pass through untouched, mid-search state intact.
+    Rows of ``boards`` outside the mask are ignored."""
+    D = state.stack_mask.shape[1]
+    fresh = init_segment_state(boards, spec, D)
+    m = inject.astype(bool)
+
+    def merge(f, s):
+        mask = m.reshape(m.shape[0], *([1] * (s.ndim - 1)))
+        return jnp.where(mask, f, s)
+
+    return SegmentState(*(merge(f, s) for f, s in zip(fresh, state)))
+
+
+def run_segment(
+    state: SegmentState,
+    seg_iters: jnp.ndarray,
+    spec: BoardSpec,
+    *,
+    locked_candidates: bool = False,
+    waves: int = 1,
+    light_waves: bool = False,
+    naked_pairs: bool | None = None,
+    packed: bool | None = None,
+    legacy_merges: bool = False,
+) -> tuple:
+    """Advance the lane pool by at most ``seg_iters`` lockstep iterations.
+
+    ``seg_iters`` is a TRACED scalar (like the closed loop's budget since
+    PR 4), so every segment of every length shares one compiled program
+    per pool width. The loop is the FLAT lockstep form — no in-jit
+    compaction ladder: between-segment lane eviction/refill IS the
+    compaction of the continuous path, and a ladder inside a bounded
+    segment would only reorder work the host is about to reclaim anyway.
+    Exits early the moment no lane is RUNNING (an idle pool costs zero
+    sweeps). Terminal lanes are stepped but are fixed points (see module
+    note); LoopStats bills them as idle lanes — the sustained-utilization
+    evidence obs/cost.py reads per segment.
+
+    Deliberately NO ``finalize_status`` at segment exit: a lane whose
+    grid completed on the segment's last step still reads RUNNING, stays
+    resident, and is flipped by its discovery sweep at the top of the
+    next segment — exactly the closed loop's counting (a solved board
+    always pays its discovery sweep there too, because its lazy RUNNING
+    status keeps the loop alive), which is what makes per-board
+    validations segment-invariant.
+
+    Returns ``(state, stats)`` with per-segment ``LoopStats``.
+    """
+
+    def cond(carry):
+        s, i, _ = carry
+        return ((s.status == RUNNING).any()) & (i < seg_iters)
+
+    def body(carry):
+        s, i, st = carry
+        st = _count_entry(st, s.status)
+        running = s.status == RUNNING
+        core = _State(
+            grid=s.grid,
+            stack_grid=s.stack_grid,
+            stack_cell=s.stack_cell,
+            stack_mask=s.stack_mask,
+            depth=s.depth,
+            status=s.status,
+            guesses=s.guesses,
+            validations=s.validations,
+            iters=jnp.int32(0),
+        )
+        core = _step(
+            core, spec, locked_candidates, waves, light_waves, naked_pairs,
+            packed, legacy_merges,
+        )
+        s = SegmentState(
+            grid=core.grid,
+            stack_grid=core.stack_grid,
+            stack_cell=core.stack_cell,
+            stack_mask=core.stack_mask,
+            depth=core.depth,
+            status=core.status,
+            guesses=core.guesses,
+            validations=core.validations,
+            board_iters=s.board_iters + running.astype(jnp.int32),
+        )
+        return s, i + 1, st
+
+    state, _, stats = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), _zero_stats())
+    )
+    return state, stats
+
+
 def _compaction_schedule(B: int, div: int = 2, floor: int = 16) -> list:
     """[B, B//div, B//div², ...] down to ``floor`` boards per slice
     (defaults are the measured CPU winners — ops/config.COMPACTION)."""

@@ -33,6 +33,22 @@ layer, the classic continuous-batching shape from serving stacks:
     waiting futures. ``inflight_depth`` bounds the pipeline (default 2);
     the bounded hand-off queue is the backpressure.
 
+Since ISSUE 12 the serving default is CONTINUOUS batching: instead of the
+closed-loop dispatcher above (every dispatch runs to completion, a
+straggler pins its whole batch while fresh arrivals wait for the *next*
+one), a single segment-driver thread runs the device loop OPEN-LOOP over
+a fixed-width lane pool. Each bounded k-iteration segment
+(ops/solver.run_segment; k = ``SolverEngine(segment_iters=...)``) carries
+the full resumable solver state device-to-device; at every segment
+boundary the driver resolves finished lanes' futures IMMEDIATELY, drops
+queued requests whose deadline passed (even while a dispatch is
+mid-flight), and injects freshly admitted boards into the freed slots
+with a one-hot on-device row merge — the vLLM/Orca iteration-level
+scheduling move applied to the solver loop. Answers are bit-identical to
+the closed loop (segmenting is schedule-independent, ops/solver.py);
+``continuous=False`` (CLI ``--no-continuous``) keeps the closed-loop
+dispatcher as the A/B arm.
+
 Frontier-routed requests (the deep-search escalation race) bypass the
 coalescer entirely — they occupy the whole mesh by design and would only
 stall the bucket pipeline (engine.solve_one routing).
@@ -62,6 +78,11 @@ from ..utils.profiling import annotate
 logger = logging.getLogger(__name__)
 
 _SENTINEL = object()
+
+# continuous-batching slot assignment (ISSUE 12): the pseudo-deadline a
+# deadline-less request boards under when lanes are contended — bounds
+# its worst-case bypass by deadline-carrying traffic (liveness floor)
+NO_DEADLINE_HORIZON_S = 60.0
 
 
 def _resolve(future: Future, result=None, exc=None) -> None:
@@ -133,6 +154,13 @@ class BatchCoalescer:
         the three wait budgets above become CAPS and each batch formation
         asks the policy for the current values (near-zero when idle,
         stretched toward the caps under load; ROADMAP open item 1).
+      continuous: run the ISSUE 12 open-loop segment driver instead of
+        the closed-loop dispatcher/completer pair (module docstring).
+        The wait budgets above do not apply — admission into a free lane
+        is immediate at every segment boundary, so a lone request's wait
+        is one in-flight segment at most. Ignored (closed loop kept) when
+        the engine has no segment program (pallas backend) or fans out
+        through a multi-host mesh_runner.
     """
 
     def __init__(
@@ -146,6 +174,7 @@ class BatchCoalescer:
         max_batch: Optional[int] = None,
         max_pending: int = 8192,
         wait_policy=None,
+        continuous: bool = False,
     ):
         if inflight_depth < 1:
             raise ValueError("inflight_depth must be >= 1")
@@ -194,6 +223,24 @@ class BatchCoalescer:
         self.failed_batches = 0
         self._wait_sum_s = 0.0
         self._wait_max_s = 0.0
+        # continuous-batching driver state (ISSUE 12)
+        self.continuous = bool(continuous)
+        self._segment_thread: Optional[threading.Thread] = None
+        self.segments = 0       # device segments dispatched
+        self.refills = 0        # boards injected into freed lanes
+        self._occupied = 0      # lanes holding a live request (gauge)
+        self._retry_threads: list = []  # in-flight capped-lane deep retries
+
+    def _continuous_active(self) -> bool:
+        """Continuous mode is only drivable when the engine actually has
+        a local segment program: the pallas backend has none, and a
+        multi-host ``mesh_runner`` fan-out speaks the (boards, iters)
+        closed-loop protocol — both keep the closed-loop dispatcher."""
+        return (
+            self.continuous
+            and getattr(self._engine, "_segment_program", None) is not None
+            and getattr(self._engine, "mesh_runner", None) is None
+        )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -201,6 +248,14 @@ class BatchCoalescer:
             if self._started:
                 return
             self._started = True
+            if self._continuous_active():
+                self._segment_thread = threading.Thread(
+                    target=self._segment_loop,
+                    name="coalescer-segments",
+                    daemon=True,
+                )
+                self._segment_thread.start()
+                return
             self._dispatcher = threading.Thread(
                 target=self._dispatcher_loop,
                 name="coalescer-dispatch",
@@ -219,7 +274,9 @@ class BatchCoalescer:
 
         Every pending/in-flight future resolves before this returns (clean
         shutdown contract): the dispatcher keeps draining after the flag
-        flips and only then hands the completer its sentinel.
+        flips and only then hands the completer its sentinel; the
+        continuous segment driver keeps running segments until every
+        resident lane resolved (capped-lane deep retries included).
         """
         with self._cond:
             if self._shutdown:
@@ -230,6 +287,10 @@ class BatchCoalescer:
             self._dispatcher.join(timeout=timeout)
         if self._completer is not None:
             self._completer.join(timeout=timeout)
+        if self._segment_thread is not None:
+            self._segment_thread.join(timeout=timeout)
+        for t in list(self._retry_threads):
+            t.join(timeout=timeout)
 
     # -- client surface ----------------------------------------------------
     def submit(
@@ -305,6 +366,21 @@ class BatchCoalescer:
                 "expired": self.expired,
                 "failed_batches": self.failed_batches,
             }
+            if self._continuous_active():
+                # the open-loop driver's view (ISSUE 12): "batches" above
+                # count SEGMENTS there, "boards" count injected requests.
+                # Gated on ACTIVE, not the flag: a multi-host leader
+                # (mesh_runner) runs the closed-loop dispatcher whatever
+                # the flag says, and /metrics must not claim otherwise
+                out["continuous"] = True
+                out["segments"] = self.segments
+                out["refills"] = self.refills
+                out["active_lanes"] = self._occupied
+                out["segment_width"] = (
+                    self._engine.segment_pool_width()
+                    if hasattr(self._engine, "segment_pool_width")
+                    else None
+                )
         with self._cond:
             out["queue_depth"] = len(self._pending)
         out["max_queue_depth"] = self.max_queue_depth
@@ -526,3 +602,311 @@ class BatchCoalescer:
                 # never marked running so cancel always succeeds);
                 # _resolve absorbs the done-check/cancel race
                 _resolve(r.future, result=res)
+
+    # -- continuous-batching segment driver (ISSUE 12) ---------------------
+    def _drain_expired_locked(self, now: float):
+        """(cond held) Remove queued requests whose deadline passed —
+        every boundary, free slots or not, so a mid-flight expiry answers
+        429 at the next segment edge instead of waiting for a lane."""
+        dropped = []
+        if any(
+            r.deadline is not None and now > r.deadline
+            for r in self._pending
+        ):
+            live = []
+            for r in self._pending:
+                if r.deadline is not None and now > r.deadline:
+                    dropped.append(r)
+                else:
+                    live.append(r)
+            self._pending.clear()
+            self._pending.extend(live)
+        return dropped
+
+    def _take_for_slots_locked(self, free: int):
+        """(cond held) Deadline-aware slot assignment: when demand exceeds
+        the freed lanes, earliest-deadline requests board first (a
+        tight-budget request dies in the queue if it yields its slot to a
+        lax one), FIFO among deadline-less requests after them."""
+        if free <= 0 or not self._pending:
+            return []
+        if len(self._pending) <= free:
+            take = list(self._pending)
+            self._pending.clear()
+            return take
+        # earliest-deadline-first, with a liveness floor: a deadline-less
+        # request boards as if its budget were NO_DEADLINE_HORIZON_S past
+        # its arrival, so sustained deadline-carrying load can delay it at
+        # most that long instead of starving it forever (a strict
+        # two-class sort re-queued it behind every fresh arrival)
+        ordered = sorted(
+            self._pending,
+            key=lambda r: (
+                r.deadline
+                if r.deadline is not None
+                else r.enqueued + NO_DEADLINE_HORIZON_S
+            ),
+        )
+        take = ordered[:free]
+        chosen = set(map(id, take))
+        live = [r for r in self._pending if id(r) not in chosen]
+        self._pending.clear()
+        self._pending.extend(live)
+        return take
+
+    def _resolve_expired(self, dropped, now: float) -> None:
+        if not dropped:
+            return
+        with self._stats_lock:
+            self.expired += len(dropped)
+        for r in dropped:
+            if r.trace is not None:
+                r.trace.mark("queue", now - r.enqueued)
+            _resolve(
+                r.future,
+                exc=DeadlineExceeded(
+                    "deadline expired in the coalescer queue"
+                ),
+            )
+
+    def _segment_loop(self) -> None:
+        """The open-loop serving driver: one thread, one lane pool, one
+        bounded segment per iteration. Between segments: resolve finished
+        lanes (futures answer IMMEDIATELY — not at batch end), evict
+        iteration-capped lanes to the deep-retry safety net, drop expired
+        queue entries, refill freed lanes from the queue. The pool state
+        never visits the host; only the packed rows do."""
+        eng = self._engine
+        width = eng.segment_pool_width()
+        N = eng.spec.size
+        C = eng.spec.cells
+        from ..ops.solver import RUNNING as _RUNNING
+
+        from ..ops.solver import pad_board
+
+        slots: list = [None] * width
+        state = None
+        zeros = np.zeros((width, N, N), np.int32)
+        pad_np = np.asarray(pad_board(eng.spec))
+        # lanes whose resident was evicted to the deep-retry net: the
+        # device row still reads RUNNING, so the lane MUST be re-seeded
+        # (with a request or an instantly-UNSAT pad) at the next boundary
+        # — otherwise the abandoned DFS keeps stepping forever, billed as
+        # busy lane work nobody is waiting for
+        stale: set = set()
+        # the idle (no-injection) argument pair, device-resident and
+        # reused: most straggler-tail segments inject nothing, and
+        # re-placing 2 KB of numpy per segment costs more than the
+        # segment fetch itself at CPU serving widths
+        import jax.numpy as jnp
+
+        idle_boards = jnp.asarray(zeros)
+        idle_inject = jnp.zeros((width,), jnp.int32)
+        # Geometric segment-budget escalation: the configured k bounds
+        # how long a FREED lane idles before refill, but when a segment
+        # resolves nothing and injects nothing (every resident lane is
+        # deep in its search), boundaries buy nothing and the per-segment
+        # dispatch/fetch overhead dominates — so the budget doubles per
+        # empty boundary, capped at 16k, and snaps back to k the moment
+        # anything resolves or boards arrive. The doubling argument
+        # bounds wasted detection delay by ~the finishing lane's actual
+        # remaining runtime; the budget is a traced argument, so the
+        # escalation never compiles a second program.
+        boost = 0
+        base_k = int(getattr(eng, "segment_iters", 1))
+        while True:
+            with self._cond:
+                while (
+                    not self._pending
+                    and not any(s is not None for s in slots)
+                    and not self._shutdown
+                ):
+                    self._cond.wait()
+                if (
+                    self._shutdown
+                    and not self._pending
+                    and not any(s is not None for s in slots)
+                ):
+                    break
+                # Burst absorption, pool-idle only: a boundary's fan-out
+                # wakes a cohort of closed-loop clients whose next
+                # requests trickle in over handler-scheduling time — an
+                # IDLE pool waits out that trickle (quiescence_s between
+                # arrivals, max_wait_s cap past the oldest) so the first
+                # segment runs full instead of half-empty. Never engages
+                # while lanes are mid-flight: a straggler's segment
+                # cadence IS the admission wait there, and delaying it
+                # would starve resident boards.
+                if not any(s is not None for s in slots):
+                    cap_at = (
+                        self._pending[0].enqueued if self._pending
+                        else time.monotonic()
+                    ) + self.max_wait_s
+                    while (
+                        len(self._pending) < width
+                        and not self._shutdown
+                    ):
+                        now = time.monotonic()
+                        quiet_at = self._last_arrival + self.quiescence_s
+                        if now >= cap_at or now >= quiet_at:
+                            break
+                        self._cond.wait(
+                            timeout=min(cap_at, quiet_at) - now
+                        )
+                now = time.monotonic()
+                dropped = self._drain_expired_locked(now)
+                free_idx = [i for i, s in enumerate(slots) if s is None]
+                take = self._take_for_slots_locked(len(free_idx))
+                self._cond.notify_all()  # submit() blocked on max_pending
+            self._resolve_expired(dropped, now)
+            if not take and not any(s is not None for s in slots):
+                continue  # everything drained had expired
+            # -- inject freshly admitted boards into the freed lanes ------
+            t_inject = time.monotonic()
+            if take or stale:
+                inject_np = np.zeros((width,), np.int32)
+                boards_np = zeros.copy()
+                for r, i in zip(take, free_idx):
+                    slots[i] = r
+                    inject_np[i] = 1
+                    boards_np[i] = r.board
+                    stale.discard(i)
+                # kill abandoned deep-retry lanes the queue didn't refill:
+                # a pad board dies in one sweep, freeing the lane's sweeps
+                for i in stale:
+                    inject_np[i] = 1
+                    boards_np[i] = pad_np
+                stale.clear()
+                boards = jnp.asarray(boards_np)
+                inject = jnp.asarray(inject_np)
+            else:
+                boards, inject = idle_boards, idle_inject
+            active = np.array([s is not None for s in slots])
+            n_active = int(active.sum())
+            if state is None:
+                state = eng.new_segment_pool(width)
+            with self._stats_lock:
+                self.batches += 1  # a segment IS a device dispatch
+                segment_id = self.batches
+                self.segments += 1
+                self.boards += len(take)
+                self.refills += len(take)
+                self.last_batch_fill = n_active
+                self._occupied = n_active
+                if n_active > self.max_batch_fill:
+                    self.max_batch_fill = n_active
+                for r in take:
+                    w = t_inject - r.enqueued
+                    self._wait_sum_s += w
+                    if w > self._wait_max_s:
+                        self._wait_max_s = w
+            cost = getattr(eng, "cost", None)
+            if cost is not None and take:
+                cost.note_formation(
+                    t_inject - min(r.enqueued for r in take), n_active
+                )
+            t_disp = time.monotonic()
+            for r in take:
+                if r.trace is not None:
+                    r.trace.mark("queue", t_inject - r.enqueued)
+                    r.trace.mark("coalesce", t_disp - t_inject)
+                    r.trace.bucket = width
+                    r.trace.batch_id = segment_id
+            # -- one supervised segment -----------------------------------
+            if take:
+                boost = 0
+            try:
+                with annotate(f"coalescer_segment_a{n_active}"):
+                    state, rows, device_s = eng.run_segment_supervised(
+                        state, boards, inject, active=active,
+                        seg_iters=base_k << boost,
+                        injected=len(take),
+                    )
+            except Exception as e:  # noqa: BLE001 — fail residents, not the loop
+                logger.exception("continuous segment failed")
+                with self._stats_lock:
+                    self.failed_batches += 1
+                t_done = time.monotonic()
+                for i, r in enumerate(slots):
+                    if r is None:
+                        continue
+                    slots[i] = None
+                    if r.trace is not None and not r.future.done():
+                        r.trace.mark("device", t_done - t_disp)
+                    _resolve(r.future, exc=e)
+                state = None  # pool state is suspect — rebuild on demand
+                stale.clear()  # a fresh pool has no abandoned lanes
+                continue
+            # -- per-segment span stamps, BEFORE any future resolves ------
+            for r in slots:
+                if (
+                    r is not None
+                    and r.trace is not None
+                    and not r.future.done()
+                ):
+                    r.trace.mark("device", device_s)
+                    r.trace.segments += 1
+            # -- compact finished lanes out: resolve / deep-retry ---------
+            resolved_rows = []
+            for i, r in enumerate(slots):
+                if r is None:
+                    continue
+                row = rows[i]
+                status = int(row[C + 1])
+                if status != _RUNNING:
+                    slots[i] = None
+                    resolved_rows.append(row)
+                    _resolve(
+                        r.future,
+                        result=eng._row_result(row, routed="continuous"),
+                    )
+                elif int(row[C + 4]) >= eng.max_iters:
+                    # iteration-capped lane (adversarial inputs only):
+                    # evict it to the deep-retry net on its own thread so
+                    # a 16x-budget solve never stalls the other lanes'
+                    # segment cadence; the lane itself is re-seeded at
+                    # the next boundary (``stale``) — its device row
+                    # still reads RUNNING and would otherwise keep
+                    # searching, billed as busy lane work
+                    slots[i] = None
+                    stale.add(i)
+                    self._spawn_deep_retry(r, row.copy())
+            if resolved_rows:
+                eng._account_coalesced(np.stack(resolved_rows))
+            # escalate on an empty boundary, snap back on any progress
+            boost = 0 if (resolved_rows or take) else min(boost + 1, 4)
+
+    def _spawn_deep_retry(self, req, row) -> None:
+        """Deep-retry an iteration-capped evicted lane off the segment
+        loop (engine._solve_padded already runs the full supervised
+        normal→deep ladder and its own cost stamping); prior segment
+        effort accumulates into the answer's counters, the staged-retry
+        contract."""
+        C = self._engine.spec.cells
+
+        def run():
+            t0 = time.monotonic()
+            try:
+                out = self._engine._solve_padded(req.board[None])[0].copy()
+                out[C + 2] += row[C + 2]
+                out[C + 3] += row[C + 3]
+                if req.trace is not None and not req.future.done():
+                    req.trace.mark("device", time.monotonic() - t0)
+                self._engine._account_coalesced(out[None])
+                _resolve(
+                    req.future,
+                    result=self._engine._row_result(
+                        out, routed="continuous-deep"
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 — fail the one request
+                logger.exception("capped-lane deep retry failed")
+                _resolve(req.future, exc=e)
+            finally:
+                self._retry_threads.remove(t)
+
+        t = threading.Thread(
+            target=run, name="coalescer-deep-retry", daemon=True
+        )
+        self._retry_threads.append(t)
+        t.start()

@@ -129,6 +129,7 @@ def seed_frontier(
     max_rounds: Optional[int] = None,
     locked: bool = False,
     initial_states: Optional[np.ndarray] = None,
+    deadline_s: Optional[float] = None,
 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Expand one board into ≥``target`` disjoint speculative states.
 
@@ -141,6 +142,12 @@ def seed_frontier(
     instead of the root board — the probe→race handoff path
     (``state_handoff_frontier``). The states must jointly cover the
     unexplored solution space for the race's verdict to be authoritative.
+
+    ``deadline_s`` (absolute monotonic, from the admission layer — ISSUE
+    12 satellite): seeding is the escalation leg's multi-round host loop,
+    so a request whose deadline passes MID-RACE is cancelled here, at the
+    next round boundary, with ``DeadlineExceeded`` (the HTTP layer's 429)
+    instead of finishing an expansion nobody is waiting for.
 
     Returns (states, solved): states is (M, N, N) with M ≥ target unless the
     search space is exhausted (then padded with instantly-unsat boards so the
@@ -163,7 +170,8 @@ def seed_frontier(
     )
     with ctx:
         return _seed_rounds(
-            states, spec, target, max_rounds, analyze_j, assign_j
+            states, spec, target, max_rounds, analyze_j, assign_j,
+            deadline_s,
         )
 
 
@@ -183,8 +191,16 @@ def _pow2_pad(states: np.ndarray, spec: BoardSpec) -> np.ndarray:
     return states
 
 
-def _seed_rounds(states, spec, target, max_rounds, analyze_j, assign_j):
+def _seed_rounds(
+    states, spec, target, max_rounds, analyze_j, assign_j, deadline_s=None
+):
     for _ in range(max_rounds):
+        if deadline_s is not None and time.monotonic() > deadline_s:
+            from ..serving.admission import DeadlineExceeded
+
+            raise DeadlineExceeded(
+                "deadline expired during frontier seeding"
+            )
         real = len(states)  # states[:real] are genuine; the rest is padding
         padded = _pow2_pad(states, spec)
         a = analyze_j(jnp.asarray(padded))
@@ -391,6 +407,7 @@ def frontier_solve(
     packed: Optional[bool] = None,
     legacy_merges: bool = False,
     initial_states: Optional[np.ndarray] = None,
+    deadline_s: Optional[float] = None,
 ) -> Tuple[Optional[list], dict]:
     """Solve one (hard) board by racing its search subtrees across the mesh.
 
@@ -406,6 +423,14 @@ def frontier_solve(
     expanding ``board`` from its root (probe→race handoff,
     ``state_handoff_frontier``); "not found" then means "not in THESE
     subtrees", so callers must pass a covering set of the unexplored space.
+
+    ``deadline_s`` (absolute monotonic, serving/admission.py — ISSUE 12
+    satellite, the farm path's PR 5 contract applied to the race): a
+    request that expires mid-escalation is cancelled with
+    ``DeadlineExceeded`` at the seeding round boundaries and once more
+    before the race dispatches. A race already ON the mesh runs to
+    completion — service time paid is never thrown away, exactly the
+    coalescer's mid-flight rule.
     """
     mesh = mesh if mesh is not None else default_mesh()
     n_dev = mesh.devices.size
@@ -422,7 +447,7 @@ def frontier_solve(
     t_seed = time.monotonic()
     states, early = seed_frontier(
         board, spec, target=target, locked=locked,
-        initial_states=initial_states,
+        initial_states=initial_states, deadline_s=deadline_s,
     )
     if tr is not None:
         tr.mark("coalesce", time.monotonic() - t_seed)
@@ -451,6 +476,14 @@ def frontier_solve(
             _unsat_pad(spec), (total - len(states), spec.size, spec.size)
         )
         states = np.concatenate([states, pad], axis=0)
+    if deadline_s is not None and time.monotonic() > deadline_s:
+        # last boundary before device work: cancel the escalation leg
+        # rather than occupy the whole mesh for an expired request
+        from ..serving.admission import DeadlineExceeded
+
+        raise DeadlineExceeded(
+            "deadline expired before the frontier race dispatched"
+        )
     racer = _make_racer(
         mesh, spec, max_iters, max_depth, locked, waves, naked_pairs,
         packed, legacy_merges,

@@ -267,6 +267,87 @@ def make_packed_serving_program(
     )
 
 
+@lru_cache(maxsize=None)
+def make_segment_serving_program(
+    mesh: Mesh,
+    spec: BoardSpec,
+    *,
+    max_depth,
+    locked_candidates: bool,
+    waves: int,
+    naked_pairs,
+    solver_overrides: tuple = (),
+):
+    """The engine's continuous-batching segment program (PR 12),
+    shard_mapped over ``data`` — the mesh twin of the single-device
+    program ``engine._build_segment_program`` jits.
+
+    Returns a jitted ``fn(state, boards, inject, seg_iters) -> (state,
+    rows)`` where ``state`` is an ``ops.solver.SegmentState`` whose
+    per-lane arrays are sharded over the mesh, ``boards``/``inject`` are
+    the refill payload ((B, N, N) boards + a (B,) one-hot lane mask, B
+    the mesh-rounded pool width so every refill respects the
+    mesh-divisible rounding by construction), and ``rows`` is the
+    (B, C+7) packed host view ``[grid | solved | status | guesses |
+    validations | board_iters | lane_steps | idle_lane_steps]`` — the
+    trailing LoopStats columns psum-reduced over the mesh then broadcast
+    per row, the same whole-call contract as the bucket program above.
+
+    Each shard's segment loop exits the moment its OWN lanes are all
+    terminal (no cross-shard sync per iteration): per-board trajectories
+    are schedule-independent, so a shard going idle early changes no
+    answer — it only stops billing idle lane sweeps, which is the point.
+    """
+    from ..ops.config import resolved_loop_shape
+    from ..ops.solver import SegmentState, inject_lanes, run_segment
+
+    data_spec = P("data")
+    overrides = dict(solver_overrides)
+    shape = resolved_loop_shape(spec.size, overrides)
+    legacy = shape["legacy"]
+    packed_planes = False if legacy else overrides.get("packed")
+    cells = spec.cells
+    if isinstance(max_depth, (tuple, list)):
+        max_depth = max(max_depth)
+
+    def _run_shard(state, boards, inject, seg_iters):
+        state = inject_lanes(state, boards, inject, spec)
+        state, lstats = run_segment(
+            state, seg_iters, spec,
+            locked_candidates=locked_candidates, waves=waves,
+            naked_pairs=naked_pairs, packed=packed_planes,
+            legacy_merges=legacy,
+        )
+        B = state.grid.shape[0]
+        lane = jax.lax.psum(lstats.lane_steps, "data")
+        idle = jax.lax.psum(lstats.idle_lane_steps, "data")
+        rows = jnp.concatenate(
+            [
+                state.grid.reshape(B, cells),
+                (state.status == 1)[:, None].astype(jnp.int32),
+                state.status[:, None],
+                state.guesses[:, None],
+                state.validations[:, None],
+                state.board_iters[:, None],
+                jnp.broadcast_to(lane, (B,))[:, None],
+                jnp.broadcast_to(idle, (B,))[:, None],
+            ],
+            axis=1,
+        )
+        return state, rows
+
+    state_specs = SegmentState(*([data_spec] * len(SegmentState._fields)))
+    return jax.jit(
+        partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(state_specs, data_spec, data_spec, P()),
+            out_specs=(state_specs, data_spec),
+            check_vma=False,
+        )(_run_shard)
+    )
+
+
 def split_evidence(packed) -> dict:
     """How a dispatched batch actually landed on the mesh, read from the
     output array's sharding metadata (no transfer, no sync): device count

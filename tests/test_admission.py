@@ -239,7 +239,13 @@ def test_adaptive_lone_request_dispatch_wait_beats_fixed_budget(boards):
     should not pay the co-rider wait at all."""
     waits = {}
     for adaptive in (False, True):
-        eng = SolverEngine(buckets=(1, 8), coalesce_adaptive=adaptive)
+        # closed-loop dispatcher on purpose: the adaptive wait policy is
+        # the CLOSED loop's machinery — the continuous segment driver
+        # (PR 12 default) admits into free lanes immediately, so both
+        # arms would read ~0 ms and prove nothing about the policy
+        eng = SolverEngine(
+            buckets=(1, 8), coalesce_adaptive=adaptive, continuous=False
+        )
         eng.warmup()
         try:
             for i in range(8):

@@ -1,0 +1,432 @@
+"""Continuous batching (PR 12): segment-vs-single-dispatch bit-identity,
+stranger rotation, mesh-sharded segment programs, mid-flight deadline
+expiry, and the golden-counter guard extended over segmentation.
+
+The correctness bar is the PR 7 compaction property one level up: the
+lockstep step is elementwise over the board axis and terminal rows are
+fixed points, so a board's solve trajectory and per-board counters must
+be BIT-IDENTICAL whether it ran in one flat dispatch or across any
+number of bounded segments with strangers rotating through the other
+lanes. Any divergence is a bug, not noise.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    oracle_is_valid_solution,
+)
+from sudoku_solver_distributed_tpu.ops import (
+    SPEC_9,
+    init_segment_state,
+    inject_lanes,
+    run_segment,
+    solve_batch,
+    spec_for_size,
+)
+from sudoku_solver_distributed_tpu.ops.config import (
+    resolved_segment_shape,
+    segment_config,
+    serving_config,
+)
+from sudoku_solver_distributed_tpu.ops.solver import RUNNING, SOLVED
+from sudoku_solver_distributed_tpu.serving.admission import DeadlineExceeded
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _corpus(name, n=None):
+    boards = np.load(os.path.join(REPO, "benchmarks", name))["boards"]
+    return boards if n is None else boards[:n]
+
+
+def _flat_cfg(size):
+    """The segment loop's closed-loop twin: serving knobs, FLAT loop
+    (compact=False) and flat depth — segments run exactly this shape."""
+    cfg = dict(serving_config(size))
+    depth = cfg.pop("max_depth")
+    if isinstance(depth, (tuple, list)):
+        depth = max(depth)
+    cfg["max_depth"] = depth
+    cfg["compact"] = False
+    return cfg
+
+
+def _seg_fn(spec, cfg):
+    return jax.jit(
+        lambda s, k: run_segment(
+            s, k, spec,
+            locked_candidates=cfg["locked_candidates"], waves=cfg["waves"],
+            naked_pairs=cfg["naked_pairs"],
+        )
+    )
+
+
+def _run_segments(spec, cfg, boards, ks, max_segments=100_000):
+    """Drive a lane pool to completion with the given (cycled) segment
+    budgets; returns the final SegmentState and summed LoopStats."""
+    fn = _seg_fn(spec, cfg)
+    state = init_segment_state(
+        jnp.asarray(boards), spec, cfg["max_depth"]
+    )
+    lane = idle = 0
+    for i in range(max_segments):
+        state, st = fn(state, jnp.int32(ks[i % len(ks)]))
+        lane += int(st.lane_steps)
+        idle += int(st.idle_lane_steps)
+        if not (np.asarray(state.status) == RUNNING).any():
+            return state, lane, idle
+    raise AssertionError("segmented solve did not finish")
+
+
+# --- bit-identity: one dispatch vs many segments ---------------------------
+
+
+@pytest.mark.parametrize(
+    "size,boards_fn",
+    [
+        (9, lambda: _corpus("corpus_9x9_hard_64.npz", 16)),
+        (16, lambda: generate_batch(4, 140, size=16, seed=12)),
+    ],
+)
+def test_segment_vs_single_dispatch_bit_identity(size, boards_fn):
+    """Boards, per-board guesses/validations, AND the LoopStats work
+    counters are bit-identical between one flat dispatch and a chain of
+    ragged segments over the same lane population (same lanes → same
+    per-iteration statuses → identical idle accounting)."""
+    spec = spec_for_size(size)
+    boards = boards_fn()
+    cfg = _flat_cfg(size)
+    res, st = jax.jit(
+        lambda g: solve_batch(g, spec, return_stats=True, **cfg)
+    )(jnp.asarray(boards))
+    res = jax.block_until_ready(res)
+    assert bool(np.asarray(res.solved).all())
+
+    # ragged segment budgets on purpose: invariance must hold for ANY cut
+    state, lane, idle = _run_segments(spec, cfg, boards, ks=(3, 7, 1, 13))
+    B = boards.shape[0]
+    np.testing.assert_array_equal(
+        np.asarray(res.grid).reshape(B, -1), np.asarray(state.grid)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.status), np.asarray(state.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.guesses), np.asarray(state.guesses)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.validations), np.asarray(state.validations)
+    )
+    assert lane == int(st.lane_steps)
+    assert idle == int(st.idle_lane_steps)
+    # the batch-scalar iters of the closed loop equals the straggler's
+    # per-lane count — the budget-cap bookkeeping the segment driver
+    # enforces from board_iters
+    assert int(np.asarray(state.board_iters).max()) == int(res.iters)
+
+
+def test_stranger_rotation_leaves_residents_bit_identical():
+    """Mid-flight injection (the one-hot masked row merge) must not
+    perturb resident lanes by a single bit, and injected strangers must
+    solve exactly as they would in their own fresh dispatch."""
+    spec = SPEC_9
+    cfg = _flat_cfg(9)
+    residents = _corpus("corpus_9x9_hard_64.npz", 8)
+    strangers = generate_batch(8, 40, seed=9)
+    ref_res = jax.jit(
+        lambda g: solve_batch(g, spec, **cfg)
+    )(jnp.asarray(residents))
+    ref_str = jax.jit(
+        lambda g: solve_batch(g, spec, **cfg)
+    )(jnp.asarray(strangers))
+
+    fn = _seg_fn(spec, cfg)
+    inject_j = jax.jit(lambda s, b, m: inject_lanes(s, b, m, spec))
+    state = init_segment_state(
+        jnp.asarray(residents), spec, cfg["max_depth"]
+    )
+    # advance a few segments, then rotate strangers through lanes 2 and 5
+    for _ in range(3):
+        state, _ = fn(state, jnp.int32(5))
+    mask = np.zeros(8, np.int32)
+    mask[2] = mask[5] = 1
+    state = inject_j(state, jnp.asarray(strangers), jnp.asarray(mask))
+    assert int(np.asarray(state.board_iters)[2]) == 0  # fresh lane
+    for _ in range(2000):
+        state, _ = fn(state, jnp.int32(6))
+        if not (np.asarray(state.status) == RUNNING).any():
+            break
+    grids = np.asarray(state.grid)
+    keep = [i for i in range(8) if i not in (2, 5)]
+    np.testing.assert_array_equal(
+        np.asarray(ref_res.grid).reshape(8, -1)[keep], grids[keep]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_res.guesses)[keep], np.asarray(state.guesses)[keep]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_str.grid).reshape(8, -1)[[2, 5]], grids[[2, 5]]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_str.guesses)[[2, 5]],
+        np.asarray(state.guesses)[[2, 5]],
+    )
+
+
+# --- mesh-sharded segment program ------------------------------------------
+
+
+def test_mesh_sharded_segments_4_fake_devices():
+    """The shard_mapped segment program over a 4-device data mesh (of the
+    suite's 8-device virtual backend): refill respects the mesh rounding
+    by construction (pool width divides the mesh) and every lane's answer
+    and counters match the single-device segment chain bit-for-bit."""
+    from jax.sharding import Mesh
+
+    from sudoku_solver_distributed_tpu.parallel.shard import (
+        make_segment_serving_program,
+    )
+
+    devices = jax.devices()
+    assert len(devices) >= 4
+    mesh = Mesh(np.array(devices[:4]), ("data",))
+    spec = SPEC_9
+    cfg = _flat_cfg(9)
+    width = 8  # mesh-divisible pool
+    prog = make_segment_serving_program(
+        mesh, spec,
+        max_depth=cfg["max_depth"],
+        locked_candidates=cfg["locked_candidates"],
+        waves=cfg["waves"],
+        naked_pairs=cfg["naked_pairs"],
+    )
+    boards = _corpus("corpus_9x9_hard_64.npz", width)
+    state = init_segment_state(
+        jnp.asarray(np.zeros((width, 9, 9), np.int32)), spec,
+        cfg["max_depth"],
+    )
+    inject = jnp.ones((width,), jnp.int32)
+    rows = None
+    state, rows = prog(state, jnp.asarray(boards), inject, jnp.int32(7))
+    none = jnp.zeros((width,), jnp.int32)
+    for _ in range(2000):
+        if not (np.asarray(rows)[:, spec.cells + 1] == RUNNING).any():
+            break
+        state, rows = prog(
+            state, jnp.asarray(boards), none, jnp.int32(7)
+        )
+    rows = np.asarray(rows)
+    C = spec.cells
+    assert (rows[:, C + 1] == SOLVED).all()
+
+    ref = jax.jit(lambda g: solve_batch(g, spec, **cfg))(
+        jnp.asarray(boards)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.grid).reshape(width, -1), rows[:, :C]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.guesses), rows[:, C + 2]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.validations), rows[:, C + 3]
+    )
+
+
+# --- the serving path: engine + continuous coalescer -----------------------
+
+
+def test_engine_continuous_default_and_arms():
+    """Continuous resolves ON for the coalesced xla path, OFF when
+    un-coalesced, and the resolved segment shape keys the AOT artifact
+    config so the two arms can never share artifacts."""
+    cont = SolverEngine(buckets=(1, 8))
+    closed = SolverEngine(buckets=(1, 8), continuous=False)
+    uncoalesced = SolverEngine(buckets=(1, 8), coalesce=False)
+    try:
+        assert cont.continuous is True
+        assert closed.continuous is False
+        assert uncoalesced.continuous is False
+        assert cont.segment_iters == segment_config(9)["k"]
+        assert cont.health()["continuous"]["enabled"] is True
+        seg_cfg = cont._program_config()["segment"]
+        assert seg_cfg == {"continuous": True, "k": cont.segment_iters}
+        assert cont._program_config() != closed._program_config()
+        with pytest.raises(ValueError, match="coalesce"):
+            SolverEngine(buckets=(1,), coalesce=False, continuous=True)
+        with pytest.raises(ValueError, match="xla"):
+            SolverEngine(buckets=(1,), backend="pallas", continuous=True)
+        with pytest.raises(ValueError, match="segment_iters"):
+            SolverEngine(buckets=(1,), segment_iters=0)
+        assert resolved_segment_shape(9, 5) == {"k": 5}
+    finally:
+        cont.close()
+        closed.close()
+        uncoalesced.close()
+
+
+def test_continuous_serving_parity_and_immediate_resolution():
+    """The serving A/B: the continuous engine answers bit-identically to
+    the closed-loop engine, resolves early finishers while a straggler
+    lane is still mid-flight, and the cost plane records the segments."""
+    cont = SolverEngine(buckets=(1, 8), segment_iters=4)
+    closed = SolverEngine(buckets=(1, 8), continuous=False)
+    try:
+        cont.warmup()
+        boards = np.concatenate(
+            [
+                generate_batch(6, 40, seed=31),
+                _corpus("corpus_9x9_hard_64.npz", 2),
+            ]
+        )
+        futs = [cont.solve_one_async(b.tolist()) for b in boards]
+        got = [f.result(timeout=120) for f in futs]
+        for b, (sol, info) in zip(boards, got):
+            assert sol is not None
+            assert info["routed"] in ("continuous", "continuous-deep")
+            ref_sol, _ = closed.solve_one(b.tolist())
+            assert sol == ref_sol
+            assert oracle_is_valid_solution(sol)
+        st = cont.coalescer.stats()
+        assert st["continuous"] is True
+        assert st["segments"] >= 2  # the deep boards spanned segments
+        assert st["refills"] == len(boards)
+        snap = cont.cost.snapshot()["continuous"]
+        assert snap["segments"] == st["segments"]
+        assert snap["resolved"] == len(boards)
+        assert 0 < snap["sustained_lane_util_pct"] <= 100
+    finally:
+        cont.close()
+        closed.close()
+
+
+def test_mid_flight_deadline_expiry_answers_429_promptly():
+    """A queued request whose deadline passes while a dispatch is
+    mid-flight is dropped at the NEXT segment boundary — not at batch
+    end: with an injected device latency pinning each segment, the
+    expired request's future raises DeadlineExceeded at the boundary and
+    the resident request is still answered normally."""
+    from sudoku_solver_distributed_tpu.utils import EngineFaultInjector
+
+    eng = SolverEngine(
+        buckets=(4,), coalesce_max_batch=4, segment_iters=2
+    )
+    try:
+        eng.warmup()
+        inj = EngineFaultInjector()
+        eng.fault_injector = inj
+        inj.set_delay(0.15)  # every segment fetch takes >= 150 ms
+        resident = eng.solve_one_async(
+            generate_batch(1, 40, seed=4)[0].tolist()
+        )
+        time.sleep(0.03)  # the first slow segment is now mid-flight
+        t0 = time.monotonic()
+        doomed = eng.solve_one_async(
+            generate_batch(1, 40, seed=5)[0].tolist(),
+            deadline_s=t0 + 0.02,
+        )
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=30)
+        waited = time.monotonic() - t0
+        # dropped at a segment boundary shortly after expiry (generous
+        # CI ceiling; the failure mode is waiting out the whole queue)
+        assert waited < 5.0, waited
+        sol, _ = resident.result(timeout=120)
+        assert sol is not None
+        assert eng.coalescer.stats()["expired"] == 1
+        inj.clear()
+        # live traffic is unaffected afterwards
+        sol, _ = eng.solve_one(generate_batch(1, 40, seed=6)[0].tolist())
+        assert sol is not None
+    finally:
+        eng.fault_injector = None
+        eng.close()
+
+
+def test_continuous_spans_cover_segments():
+    """A deep request's trace accumulates device time across segments
+    and records how many segments its device span covered."""
+    from sudoku_solver_distributed_tpu.obs import Tracer
+
+    eng = SolverEngine(buckets=(1, 4), segment_iters=4)
+    try:
+        eng.warmup()
+        tracer = Tracer()
+        t = tracer.start("/solve")
+        sol, _ = eng.solve_one(_corpus("corpus_9x9_hard_64.npz", 1)[0].tolist())
+        rec = tracer.finish(t, 200)
+        assert sol is not None
+        assert rec["device_ms"] > 0
+        assert rec["segments"] >= 2  # a deep board spans segments
+        assert rec["bucket"] == eng.segment_pool_width()
+    finally:
+        eng.close()
+
+
+def test_capped_lane_evicts_to_deep_retry_and_pool_stays_healthy():
+    """A lane that exhausts its per-board iteration budget is evicted to
+    the deep-retry net (answered off the segment loop, counters
+    accumulated) and its abandoned device row is re-seeded at the next
+    boundary — later traffic through the same pool serves normally."""
+    # max_iters=2: the hard board (8 fused lockstep iterations under the
+    # serving config) caps after the first k=2 segment; the deep retry's
+    # 2x128 budget then answers it off the pool
+    eng = SolverEngine(
+        buckets=(4,), max_iters=2, deep_retry_factor=128, segment_iters=2
+    )
+    try:
+        eng.warmup()
+        board = _corpus("corpus_9x9_hard_64.npz", 1)[0]
+        sol, info = eng.solve_one(board.tolist())
+        assert sol is not None, info
+        assert info["routed"] == "continuous-deep"
+        assert oracle_is_valid_solution(sol)
+        # the pool keeps serving after the eviction (the capped lane was
+        # re-seeded, not left running an abandoned search)
+        for seed in (8, 9):
+            b = generate_batch(1, 45, seed=seed)[0]
+            sol, _ = eng.solve_one(b.tolist())
+            assert sol is not None
+    finally:
+        eng.close()
+
+
+# --- golden-counter guard over segmentation --------------------------------
+
+
+def test_golden_counters_hold_under_segmentation():
+    """The ISSUE 7 golden guard extended (ISSUE 12 satellite):
+    segmenting the deep-union corpus cannot drift the pinned
+    iters/guesses — per-board counters are segment-invariant, so the
+    sums must stay within the committed +5%% envelope (flat full-depth
+    stack, so staged-retry double-billing cannot INFLATE them either)."""
+    golden = json.load(
+        open(os.path.join(REPO, "tests", "golden_counters.json"))
+    )
+    boards = _corpus(golden["corpus"])
+    cfg = _flat_cfg(9)
+    cfg["max_iters"] = golden["config"]["max_iters"]
+    state, _lane, _idle = _run_segments(
+        SPEC_9, cfg, boards, ks=(997, 251)
+    )
+    status = np.asarray(state.status)
+    assert int((status == SOLVED).sum()) == golden["solved"]
+    measured = {
+        "iters": int(np.asarray(state.board_iters).max()),
+        "guesses": int(np.asarray(state.guesses).sum()),
+        "validations": int(np.asarray(state.validations).sum()),
+    }
+    for key, value in measured.items():
+        assert value <= golden[key] * 1.05, (
+            f"{key} drifted under segmentation: {value} vs golden "
+            f"{golden[key]}"
+        )

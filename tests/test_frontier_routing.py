@@ -32,8 +32,8 @@ def _spy_engine(**kw):
     calls = []
     orig = eng._frontier_solve
 
-    def spy(arr, seed_states=None):
-        out = orig(arr, seed_states)
+    def spy(arr, seed_states=None, deadline_s=None):
+        out = orig(arr, seed_states, deadline_s)
         calls.append(out[1])
         return out
 
@@ -285,3 +285,59 @@ def test_deep_mined_board_escalates_under_default_budget():
     assert oracle_is_valid_solution(solution)
     assert info["frontier"] is True
     assert len(race_calls) == 1 and eng.frontier_escalations == 1
+
+
+def test_deadline_cancels_escalation_leg(readme_puzzle):
+    """ISSUE 12 satellite (the PR 5 farm contract applied to the race): a
+    request that expires after its probe — mid-escalation — cancels the
+    race leg with DeadlineExceeded (the 429 path) instead of occupying
+    the whole mesh, and never downgrades to a bucket-path answer nobody
+    is waiting for."""
+    import time
+
+    import pytest
+
+    from sudoku_solver_distributed_tpu.serving.admission import (
+        DeadlineExceeded,
+    )
+
+    # 4-iteration probe: the README board escalates (see above); the
+    # already-expired deadline must stop the escalation at its boundary
+    eng, race_calls = _spy_engine(frontier_escalate_iters=4)
+    with pytest.raises(DeadlineExceeded):
+        eng.solve_one(readme_puzzle, deadline_s=time.monotonic() - 0.001)
+    assert race_calls == []  # the race leg never dispatched
+
+    # the same contract through the serving entry point (the path the
+    # HTTP layer maps to 429)
+    with pytest.raises(DeadlineExceeded):
+        eng.solve_one_supervised(
+            readme_puzzle, deadline_s=time.monotonic() + 1e-4
+        )
+
+    # an unexpired deadline serves normally through the race
+    solution, info = eng.solve_one(
+        readme_puzzle, deadline_s=time.monotonic() + 120.0
+    )
+    assert oracle_is_valid_solution(solution)
+    assert info["frontier"] is True
+
+
+def test_seeding_checks_deadline_between_rounds(readme_puzzle):
+    """frontier_solve's seeding loop (the escalation leg's host-driven
+    expansion) cancels at a round boundary once the deadline passes."""
+    import time
+
+    import pytest
+
+    from sudoku_solver_distributed_tpu.parallel import frontier_solve
+    from sudoku_solver_distributed_tpu.serving.admission import (
+        DeadlineExceeded,
+    )
+
+    mesh = default_mesh()
+    with pytest.raises(DeadlineExceeded):
+        frontier_solve(
+            readme_puzzle, mesh, states_per_device=8,
+            deadline_s=time.monotonic() - 0.001,
+        )

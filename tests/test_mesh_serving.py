@@ -128,7 +128,9 @@ def test_coalesced_serving_answers_identical_on_mesh():
         for b, (sol, info) in zip(boards, got):
             ref_sol, _ = es.solve_one(b.tolist())
             assert sol == ref_sol
-            assert info["routed"] == "coalesced"
+            # the continuous segment driver (PR 12 default) labels the
+            # route; a --no-continuous engine would answer "coalesced"
+            assert info["routed"] in ("coalesced", "continuous")
         stats = em.coalescer.stats()
         assert stats["batches"] >= 1 and stats["boards"] == 12
         mi = em.mesh_info()

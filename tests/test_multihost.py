@@ -79,7 +79,9 @@ def test_sim_mesh_dispatch_padding_and_aot_cold_start(sim, tmp_path):
     assert bake["mesh"]["dispatches"] >= 2  # batch tiles + coalesced one
     assert bake["mesh"]["last_split"]["devices"] == 4
     assert bake["mesh"]["min_devices_seen"] == 4
-    assert bake["routed"] == "coalesced"
+    # the continuous segment driver (PR 12 default) labels the route;
+    # a --no-continuous child would answer "coalesced"
+    assert bake["routed"] in ("coalesced", "continuous")
     assert set(bake["sources"].values()) == {"compile+save"}
 
     fresh = sim.run_json(

@@ -586,8 +586,8 @@ def test_http_solve_frontier_path(readme_puzzle):
     calls = []
     orig = eng._frontier_solve
 
-    def spy(arr, seed_states=None):
-        out = orig(arr, seed_states)
+    def spy(arr, seed_states=None, deadline_s=None):
+        out = orig(arr, seed_states, deadline_s)
         calls.append(out[1])
         return out
 

@@ -206,7 +206,12 @@ def test_breaker_trip_dumps_flightrecord_with_poisoned_span(
     flight.attach_supervisor(sup)
     try:
         assert sup.state == HEALTHY
+        # poison both widths the coalesced path may dispatch at: the
+        # continuous segment driver (PR 12 default) runs the lane pool
+        # at the bucket covering the batch cap (4 here), the closed-loop
+        # A/B arm would dispatch the lone request at width 1
         inj.poison_bucket(1)
+        inj.poison_bucket(4)
         trace = tracer.start("/solve")
         solution, info = engine.solve_one_supervised(BOARD)
         tracer.finish(trace, 200, degraded=bool(info.get("degraded")))
@@ -229,7 +234,7 @@ def test_breaker_trip_dumps_flightrecord_with_poisoned_span(
         assert span["device_ms"] > 0       # the poisoned device call ran
         assert span["verify_ms"] >= 0.0    # verification caught it
         assert span["fallback_ms"] > 0     # the oracle answered
-        assert span["bucket"] == 1 and span["batch_id"] >= 1
+        assert span["bucket"] in (1, 4) and span["batch_id"] >= 1
     finally:
         sup.close()
         engine.supervisor = None

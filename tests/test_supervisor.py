@@ -23,7 +23,10 @@ import numpy as np
 import pytest
 
 from sudoku_solver_distributed_tpu.engine import SolverEngine
-from sudoku_solver_distributed_tpu.models import oracle_is_valid_solution
+from sudoku_solver_distributed_tpu.models import (
+    generate_batch,
+    oracle_is_valid_solution,
+)
 from sudoku_solver_distributed_tpu.net import wire
 from sudoku_solver_distributed_tpu.net.http_api import make_http_server
 from sudoku_solver_distributed_tpu.net.node import P2PNode, TASK_DEADLINE_S
@@ -872,3 +875,70 @@ def test_supervisor_transition_reanchors_admission(supervised):
     inj.clear()
     assert sup.probe() is True  # DEGRADED -> HEALTHY re-anchors again
     assert adm.snapshot()["reanchors"] == 2
+
+
+def test_solve_batch_degraded_answers_boards_not_errors():
+    """ISSUE 12 satellite — the PR 5 known limit on /solve_batch closed:
+    an open breaker (and a device failure mid-batch) routes boards
+    through the supervised oracle fallback and answers degraded-mode
+    boards with per-board flags, never a whole-batch error."""
+    eng = SolverEngine(buckets=(1, 4), coalesce=False)
+    eng.warmup()
+    inj = EngineFaultInjector()
+    eng.fault_injector = inj
+    sup = EngineSupervisor(eng, probe_interval_s=600.0)
+    boards = generate_batch(3, 45, seed=83)
+    try:
+        # healthy: device path, no degraded flags
+        sols, mask, info = eng.solve_batch_np_supervised(boards)
+        assert bool(mask.all()) and info["degraded"] is False
+        assert info["degraded_boards"] == [False, False, False]
+
+        # device failure mid-batch: the batch falls back per board
+        inj.arm_fail_next(1)
+        sols, mask, info = eng.solve_batch_np_supervised(boards)
+        assert bool(mask.all())
+        assert info["degraded"] is True
+        assert info["degraded_boards"] == [True, True, True]
+        for i in range(3):
+            assert oracle_is_valid_solution(sols[i].tolist())
+            clue = boards[i] > 0
+            assert (sols[i][clue] == boards[i][clue]).all()
+        assert sup.state == DEGRADED
+
+        # breaker open: the device is never touched, the oracle answers
+        calls_before = inj.counts()["calls"]
+        sols, mask, info = eng.solve_batch_np_supervised(boards)
+        assert bool(mask.all()) and info["degraded"] is True
+        assert inj.counts()["calls"] == calls_before  # no device call
+        assert sup.fallback_served >= 6
+
+        # the HTTP body contract: per-board flags + X-Degraded summary
+        from sudoku_solver_distributed_tpu.net import http_api
+        from sudoku_solver_distributed_tpu.net.node import P2PNode
+
+        node = P2PNode("127.0.0.1", 0, engine=eng, failure_timeout=0.0)
+        body = json.dumps(
+            {"sudokus": [b.tolist() for b in boards]}
+        ).encode()
+        status, payload, error, degraded = http_api.solve_batch_route(
+            node, body
+        )
+        assert status == 200 and not error and degraded is True
+        assert payload["solved"] == 3
+        assert payload["degraded"] == [True, True, True]
+
+        # recovery: the probe re-admits the device and the degraded keys
+        # disappear from healthy bodies again
+        inj.clear()
+        assert sup.probe() is True
+        status, payload, error, degraded = http_api.solve_batch_route(
+            node, body
+        )
+        assert status == 200 and degraded is False
+        assert "degraded" not in payload
+    finally:
+        sup.close()
+        eng.supervisor = None
+        eng.fault_injector = None
+        eng.close()
