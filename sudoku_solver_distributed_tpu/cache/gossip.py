@@ -1,0 +1,373 @@
+"""Fleet cache convergence: hot-set gossip + peer answer fetch.
+
+The cluster layer of the answer cache (ISSUE 13 tentpole 3). Two wire
+surfaces, both speaking the existing UDP protocol's idioms:
+
+  * **hot-set digest** — each node's top-K canonical hashes (+ hit
+    counts) ride the 1 Hz stats heartbeat as an optional trailing
+    ``hotset`` key (net/wire.stats_msg — the PR 5/10 variant pattern;
+    absent key keeps reference traffic byte-identical). Peers fold the
+    digest into a TTL'd, bounded, ingress-sanitized map
+    (:class:`PeerHotset`) — evidence, not membership, exactly like
+    PeerHealth/PeerTelemetry.
+  * **cache_get / cache_answer** — a node that MISSES locally on a key
+    some fresh peer advertises sends ``cache_get`` and waits a bounded
+    beat for the ``cache_answer`` carrying the canonical (board,
+    solution) pair. The answer is verified on arrival through the
+    store's write gate (cache/store.py ``store_canonical``: re-hashed
+    under OUR canonicalization, rule-checked host-side), so a hostile or
+    corrupt peer answer is counted and dropped, never served. The fetch
+    replaces a device dispatch; a timeout just falls through to the
+    normal solve path.
+
+Net effect: one node solves the viral puzzle, every node answers its
+whole symmetry orbit from cache within a gossip interval.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# a canonical key is a 64-char lowercase sha256 hex digest — the ingress
+# shape gate for every wire-carried hash field
+_KEY_RE = re.compile(r"^[0-9a-f]{64}$")
+
+DIGEST_VERSION = 1
+
+
+def valid_key(raw) -> Optional[str]:
+    """Wire-ingress validation of a canonical hash; None when malformed."""
+    if isinstance(raw, str) and _KEY_RE.fullmatch(raw):
+        return raw
+    return None
+
+
+class PeerHotset:
+    """Last-known hot-set digest per peer, carried by the ``hotset``
+    piggyback on stats gossip. Same evidence-not-membership contract as
+    net/stats.PeerHealth: entries EXPIRE (``ttl_s``), departures forget
+    the peer, and both the peer count and the keys-per-peer are bounded
+    with full ingress sanitization — a hostile datagram can neither grow
+    the heap nor plant garbage keys."""
+
+    MAX_ENTRIES = 256   # peers tracked (flood bound, same as PeerHealth)
+    MAX_KEYS = 32       # hot keys accepted per peer digest
+
+    def __init__(self, ttl_s: float = 15.0):
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        # peer -> (frozenset of keys, {key: hits}, monotonic receive t)
+        self._sets: Dict[str, tuple] = {}
+
+    @classmethod
+    def sanitize(cls, raw) -> Optional[Dict[str, int]]:
+        """{"v": 1, "keys": [[hex, hits], ...]} → {hex: hits}, or None.
+        Rejected whole on any malformed element — partial acceptance
+        would let one valid key smuggle junk siblings in."""
+        if not isinstance(raw, dict):
+            return None
+        keys = raw.get("keys")
+        if not isinstance(keys, list) or len(keys) > cls.MAX_KEYS:
+            return None
+        out: Dict[str, int] = {}
+        for item in keys:
+            if not isinstance(item, (list, tuple)) or len(item) != 2:
+                return None
+            key, hits = item
+            if valid_key(key) is None:
+                return None
+            if not isinstance(hits, int) or isinstance(hits, bool) or (
+                not 0 <= hits < 1 << 31
+            ):
+                # an absurd claimed count is a lie, and lies rank fetch
+                # targets (holders sorts hottest-first) — rejected
+                # whole like every other malformed digest
+                return None
+            out[key] = hits
+        return out
+
+    def _purge_locked(self, now: float) -> None:
+        """(lock held) Drop expired digests — the ONE expiry rule every
+        reader applies, so holders() can never offer a fetch target
+        snapshot() already considers dead."""
+        for p in [
+            p
+            for p, (_, _, t) in self._sets.items()
+            if now - t > self.ttl_s
+        ]:
+            del self._sets[p]
+
+    def note(self, peer: str, raw) -> None:
+        digest = self.sanitize(raw)
+        if digest is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._sets[peer] = (frozenset(digest), digest, now)
+            if len(self._sets) > self.MAX_ENTRIES:
+                self._purge_locked(now)
+            while len(self._sets) > self.MAX_ENTRIES:
+                oldest = min(
+                    self._sets.items(), key=lambda kv: kv[1][2]
+                )
+                del self._sets[oldest[0]]
+
+    def holders(self, key: str) -> List[str]:
+        """Peers whose FRESH (unexpired) digest advertises ``key``,
+        hottest-first (the advertised hit count ranks fetch targets: a
+        peer serving the key thousands of times is the likeliest to
+        still hold it and the least bothered by one more get)."""
+        now = time.monotonic()
+        with self._lock:
+            self._purge_locked(now)
+            matches = [
+                (p, hits.get(key, 0))
+                for p, (keys, hits, _) in self._sets.items()
+                if key in keys
+            ]
+        matches.sort(key=lambda ph: -ph[1])
+        return [p for p, _ in matches]
+
+    def forget(self, peer: str) -> None:
+        with self._lock:
+            self._sets.pop(peer, None)
+
+    def snapshot(self) -> Dict[str, dict]:
+        now = time.monotonic()
+        with self._lock:
+            self._purge_locked(now)
+            return {
+                p: {"age_s": round(now - t, 3), "keys": len(keys)}
+                for p, (keys, _, t) in self._sets.items()
+            }
+
+
+class CacheGossip:
+    """One node's cache-convergence plane: builds the outgoing hot-set
+    digest (cached between heartbeats, like obs/cluster's publisher),
+    folds peers' digests, answers ``cache_get``, verifies
+    ``cache_answer``, and runs the bounded blocking fetch the front door
+    calls on a peer-hot miss.
+
+    Args:
+      cache: the node's AnswerCache.
+      node: the owning P2PNode (send surface + identity).
+      top_k: hot-set size gossiped per heartbeat.
+      fetch_timeout_s: how long a miss waits for a peer answer before
+        falling through to the normal solve path. Bounded and small on
+        purpose: the fallback is not an error, it is the device doing
+        its job.
+      fanout: peers asked per fetch (first answer wins; the rest are
+        idempotent folds).
+      max_concurrent_fetches: handler threads allowed to be parked in
+        ``try_peer_fetch`` at once. The fetch runs BEFORE admission (a
+        hot key must be answerable even when the backlog would shed),
+        so without a bound a burst of misses on stale-advertised keys
+        could park the whole transport worker pool for a fetch-timeout
+        each; at the cap a miss just dispatches normally.
+    """
+
+    def __init__(
+        self,
+        cache,
+        node,
+        *,
+        top_k: int = 16,
+        ttl_s: float = 15.0,
+        fetch_timeout_s: float = 0.25,
+        fanout: int = 2,
+        min_interval_s: float = 1.0,
+        max_concurrent_fetches: int = 8,
+    ):
+        self.cache = cache
+        self.node = node
+        self.top_k = int(top_k)
+        self.fetch_timeout_s = float(fetch_timeout_s)
+        self.fanout = max(1, int(fanout))
+        self.peers = PeerHotset(ttl_s=ttl_s)
+        self.min_interval_s = min_interval_s
+        self.max_concurrent_fetches = max(1, int(max_concurrent_fetches))
+        self._fetching = 0  # parked fetchers (under _waiters_lock)
+        self.fetches_capped = 0  # misses that skipped the fetch at cap
+        self.unsolicited_answers = 0  # answers dropped, no fetch waiting
+        self._fetch_rotation = 0  # round-robin over non-top holders
+        self._digest_lock = threading.Lock()
+        self._cached_digest: Optional[dict] = None
+        self._cached_at = 0.0
+        # key -> (threading.Event, waiter count); signaled by
+        # on_cache_answer after a verified fold lands under that key
+        self._waiters: Dict[str, Tuple[threading.Event, int]] = {}
+        self._waiters_lock = threading.Lock()
+        self.peer_serves = 0  # cache_get datagrams answered (benign race)
+
+    # -- outgoing digest ---------------------------------------------------
+    def digest(self) -> Optional[dict]:
+        """The ``hotset`` payload for the next stats heartbeat, rebuilt
+        at most once per ``min_interval_s`` (broadcast_stats runs once
+        per /solve on the serving path); None — key absent on the wire —
+        while the cache is empty."""
+        now = time.monotonic()
+        with self._digest_lock:
+            if (
+                self._cached_digest is not None
+                and now - self._cached_at < self.min_interval_s
+            ):
+                return self._cached_digest or None
+            hot = self.cache.hot_set(self.top_k)
+            self._cached_digest = (
+                {"v": DIGEST_VERSION, "keys": [[k, h] for k, h in hot]}
+                if hot
+                else {}
+            )
+            self._cached_at = now
+            return self._cached_digest or None
+
+    # -- ingress (UDP loop thread, net/node.py) ----------------------------
+    def note_hotset(self, peer: str, raw) -> None:
+        self.peers.note(peer, raw)
+
+    def on_cache_get(self, msg, source=None) -> None:
+        """Answer a peer's fetch from our store; unknown keys are
+        silently ignored (the peer's timeout is the negative reply —
+        a 'not found' datagram would only invite spoofed floods).
+
+        Reflection guard: the multi-KB positive reply goes to the
+        claimed ``address`` only when it matches the datagram's UDP
+        ``source`` (wire.same_endpoint — nodes send from their bound
+        socket, the same identity rule goodbyes use). Without the
+        check, a ~120-byte spoofed get for a gossip-advertised hot key
+        would reflect a 15-30× larger cache_answer at any victim."""
+        from ..net import wire
+
+        key = valid_key(msg["hash"])
+        if key is None:
+            return
+        if source is not None:
+            try:
+                claimed = wire.parse_address(msg["address"])
+            except (ValueError, TypeError):
+                return
+            if not wire.same_endpoint(tuple(source[:2]), claimed):
+                logger.warning(
+                    "dropping cache_get whose address %r does not "
+                    "match its source %r", msg["address"], source,
+                )
+                return
+        pair = self.cache.get_canonical(key)
+        if pair is None:
+            return
+        board, solution = pair
+        self.node.send_to(
+            msg["address"],
+            wire.cache_answer_msg(key, board, solution, self.node.id),
+        )
+        self.peer_serves += 1
+
+    def on_cache_answer(self, msg) -> None:
+        """Fold a peer's answer through the store's write gate, then
+        wake the fetch waiting on that key. The claimed hash is never
+        trusted: store_canonical re-canonicalizes the carried board, so
+        the entry lands under the key WE compute — the waiter's
+        post-wake ``contains`` check closes the loop.
+
+        SOLICITED answers only: a datagram for a key no fetch is
+        waiting on is dropped before any verification runs. Without the
+        gate, an attacker streaming valid-but-unsolicited (board,
+        solution) pairs — trivial to mint from any complete grid —
+        would both flush the genuine hot set through the per-shard LRU
+        and burn ~0.5 ms of canonicalize+verify on the UDP ingress
+        thread per datagram, starving heartbeat/membership processing.
+        Waiters register BEFORE the gets go out (try_peer_fetch), so a
+        legitimate answer always finds its waiter; late answers after
+        the timeout are dropped like any other unsolicited datagram
+        (the asking node will re-fetch or has already dispatched)."""
+        key = valid_key(msg["hash"])
+        if key is None:
+            return
+        with self._waiters_lock:
+            entry = self._waiters.get(key)
+        if entry is None:
+            self.unsolicited_answers += 1  # benign-race counter
+            return
+        if not self.cache.store_canonical(msg["board"], msg["solution"]):
+            return
+        entry[0].set()
+
+    # -- the front door's fetch (handler thread) ---------------------------
+    def try_peer_fetch(self, key: str, timeout_s=None) -> bool:
+        """On a local miss: if any fresh peer advertises ``key``, ask up
+        to ``fanout`` of them and wait (bounded) for a verified answer
+        to land. True iff the cache now holds the key — the caller
+        re-runs its lookup and serves the hit.
+
+        ``timeout_s`` caps the wait BELOW the configured fetch timeout
+        (never above): the front door passes the request's remaining
+        deadline budget, so a 50 ms-budget request never parks 250 ms
+        for an answer it could no longer use."""
+        wait_s = self.fetch_timeout_s
+        if timeout_s is not None:
+            wait_s = min(wait_s, timeout_s)
+        if wait_s <= 0:
+            return False  # disabled (CLI timeout 0) or budget spent
+        holders = self.peers.holders(key)
+        if not holders:
+            return False
+        from ..net import wire
+
+        with self._waiters_lock:
+            if self._fetching >= self.max_concurrent_fetches:
+                # the park budget is spent: this miss dispatches
+                # normally instead of joining a pile-up that could
+                # exhaust the transport worker pool pre-admission
+                self.fetches_capped += 1
+                return False
+            self._fetching += 1
+            ev, count = self._waiters.get(key, (threading.Event(), 0))
+            self._waiters[key] = (ev, count + 1)
+        try:
+            self.cache._count("peer_fetches")
+            msg = wire.cache_get_msg(key, self.node.id)
+            # top-(fanout−1) hottest holders plus ONE rotated from the
+            # rest: a pair of hostile peers advertising inflated counts
+            # can then monopolize at most fanout−1 slots — an honest
+            # holder is still asked within len(holders) fetches
+            targets = holders[: max(1, self.fanout - 1)]
+            rest = holders[len(targets):]
+            if rest and len(targets) < self.fanout:
+                self._fetch_rotation += 1
+                targets.append(rest[self._fetch_rotation % len(rest)])
+            for peer in targets:
+                self.node.send_to(peer, msg)
+            ev.wait(wait_s)
+        finally:
+            with self._waiters_lock:
+                self._fetching -= 1
+                ev2, count2 = self._waiters.get(key, (ev, 1))
+                if count2 <= 1:
+                    self._waiters.pop(key, None)
+                else:
+                    self._waiters[key] = (ev2, count2 - 1)
+        return self.cache.contains(key)
+
+    def forget(self, peer: str) -> None:
+        """A departed peer's advertisements die with it."""
+        self.peers.forget(peer)
+
+    def snapshot(self) -> dict:
+        """The gossip half of the ``engine.cost.cache`` metrics block —
+        scalar gauges only (the block flattens into Prometheus names;
+        per-peer detail lives on ``peers.snapshot()`` for tests/debug)."""
+        return {
+            "peers_advertising": len(self.peers.snapshot()),
+            "peer_serves": self.peer_serves,
+            "fetches_capped": self.fetches_capped,
+            "unsolicited_answers": self.unsolicited_answers,
+            "top_k": self.top_k,
+            "fetch_timeout_ms": round(self.fetch_timeout_s * 1e3, 1),
+        }

@@ -216,6 +216,7 @@ class SolverEngine:
         coalesce_adaptive: bool = False,
         continuous: Optional[bool] = None,
         segment_iters: Optional[int] = None,
+        deep_lane_cap: int = 0,
         compile_cache_dir: Optional[str] = None,
         aot_artifacts: bool = True,
         solver_config=None,
@@ -525,6 +526,11 @@ class SolverEngine:
                     "sharded segment program to ride otherwise"
                 )
         self.continuous = bool(continuous)
+        # long-job lane cap for the continuous driver (ISSUE 13
+        # satellite, CLI --deep-lane-cap): bound the lanes deep-resident
+        # boards may hold while fresh demand queues; overage evicts to
+        # the deep-retry net (parallel/coalescer.py). 0 = off.
+        self.deep_lane_cap = int(deep_lane_cap)
         self._coalescer = None
         self._coalescer_init_lock = threading.Lock()
         # Failure-domain supervision (ISSUE 5, serving/health.py): when an
@@ -871,6 +877,7 @@ class SolverEngine:
                         max_batch=self.coalesce_max_batch,
                         wait_policy=wait_policy,
                         continuous=self.continuous,
+                        deep_lane_cap=self.deep_lane_cap,
                     )
         return self._coalescer
 

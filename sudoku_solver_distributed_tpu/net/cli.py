@@ -38,6 +38,21 @@ Extensions (defaults preserve reference behavior):
                 pool, resolving finished lanes and injecting fresh boards
                 mid-flight; --no-continuous restores the closed-loop
                 dispatcher (A/B arm), --segment-iters sweeps k
+  --deep-lane-cap
+                with continuous batching: bound the lanes boards resident
+                longer than a few segments may occupy while demand
+                queues; overage evicts to the deep-retry net (fairness
+                slice, ISSUE 13). 0 (default) = no cap
+  --no-answer-cache / --answer-cache-capacity / --cache-fetch-timeout-ms
+                canonical-form answer cache (cache/, ISSUE 13; ON by
+                default): /solve and /solve_batch boards canonicalize
+                over the sudoku symmetry group at the front door and
+                repeats — or symmetries — of already-verified answers
+                serve from an LRU in microseconds (X-Cache: hit) without
+                touching admission or the device; the hot-set digest
+                gossips on the stats heartbeat and local misses on
+                peer-advertised keys fetch the answer over UDP (verified
+                on arrival). --no-answer-cache is the A/B escape hatch
   --profile-dir write a jax.profiler device trace of each /solve to this dir
   --failure-timeout
                 seconds of neighbor silence before a crash is declared (the
@@ -318,6 +333,40 @@ def build_parser() -> argparse.ArgumentParser:
         "way",
     )
     parser.add_argument(
+        "--deep-lane-cap",
+        type=int,
+        default=0,
+        help="with continuous batching: max lanes boards resident past "
+        "a few segment boundaries may hold while fresh demand queues — "
+        "overage evicts to the deep-retry net so deep-heavy overload "
+        "stops squeezing refill goodput (parallel/coalescer.py). "
+        "0 (default) = no cap",
+    )
+    parser.add_argument(
+        "--no-answer-cache",
+        action="store_true",
+        help="disable the canonical-form answer cache (cache/): every "
+        "request pays full admission + dispatch even for a repeat or a "
+        "symmetry of an already-answered puzzle (the A/B baseline of "
+        "bench.py --mode cache)",
+    )
+    parser.add_argument(
+        "--answer-cache-capacity",
+        type=int,
+        default=4096,
+        help="answer-cache entries across all shards (one entry serves "
+        "a puzzle's whole symmetry orbit); per-shard LRU eviction past "
+        "it",
+    )
+    parser.add_argument(
+        "--cache-fetch-timeout-ms",
+        type=float,
+        default=250.0,
+        help="how long a local cache miss on a peer-advertised hot key "
+        "waits for the peer's cache_answer before dispatching normally "
+        "(cache/gossip.py); 0 disables peer fetching",
+    )
+    parser.add_argument(
         "--segment-iters",
         type=int,
         default=None,
@@ -515,6 +564,7 @@ def main(argv=None) -> None:
         # is the closed-loop A/B escape hatch
         "continuous": False if args.no_continuous else None,
         "segment_iters": args.segment_iters,
+        "deep_lane_cap": args.deep_lane_cap,
         "compile_cache_dir": args.compile_cache_dir,
         "solver_config": args.solver_config,
     }
@@ -717,6 +767,22 @@ def main(argv=None) -> None:
     node.tracer = tracer
     node.flight = flight
     node.slo = slo
+    if not args.no_answer_cache:
+        # canonical-form answer cache (cache/, ISSUE 13; default ON):
+        # front-door lookup in the /solve and /solve_batch route cores,
+        # verified-only writes, hot-set gossip on the stats heartbeat,
+        # peer fetch on advertised keys. --no-answer-cache is the A/B
+        # baseline (bench.py --mode cache)
+        from ..cache import AnswerCache, CacheGossip
+
+        node.answer_cache = AnswerCache(
+            capacity=max(1, args.answer_cache_capacity)
+        )
+        node.cache_gossip = CacheGossip(
+            node.answer_cache,
+            node,
+            fetch_timeout_s=max(0.0, args.cache_fetch_timeout_ms) / 1e3,
+        )
     if tracer is not None:
         # fleet telemetry publisher (ISSUE 10, obs/cluster.py): this
         # node's digest rides every stats-gossip heartbeat (rebuilt at

@@ -331,7 +331,7 @@ class FastHTTPServer:
         ):
             trace = http_api.start_trace(self.p2p_node, path_s, req_id)
         try:
-            status, payload, close_after, degraded = self._route(
+            status, payload, close_after, degraded, cached = self._route(
                 method,
                 path_s,
                 body,
@@ -353,7 +353,7 @@ class FastHTTPServer:
         )
         self._reply(
             conn, status, payload, close=close or close_after,
-            degraded=degraded,
+            degraded=degraded, cached=cached,
             request_id=req_id,
             timing=http_api.timing_header_value(record)
             if record is not None and want_timing
@@ -366,37 +366,40 @@ class FastHTTPServer:
         self, method: bytes, path: str, body: bytes, t0: float,
         deadline_ms=None,
     ):
-        """Returns (status, payload, close_after, degraded). Bodies come
-        from the shared route cores — byte-identical to the stock
-        transport; ``degraded`` marks fallback-served /solve answers
-        (the X-Degraded header)."""
+        """Returns (status, payload, close_after, degraded, cached).
+        Bodies come from the shared route cores — byte-identical to the
+        stock transport; ``degraded`` marks fallback-served /solve
+        answers (the X-Degraded header), ``cached`` answers served from
+        the canonical-form cache (the X-Cache: hit header)."""
         node = self.p2p_node
         if method == b"POST":
             if path == "/solve":
-                status, payload, error, degraded = http_api.solve_route(
-                    node, body, deadline_ms=deadline_ms
+                status, payload, error, degraded, cached = (
+                    http_api.solve_route(
+                        node, body, deadline_ms=deadline_ms
+                    )
                 )
                 shed = status == 429
                 self._record(
                     "/solve", t0, error=error and not shed, shed=shed
                 )
-                return status, payload, False, degraded
+                return status, payload, False, degraded, cached
             if path == "/solve_batch" and self.expose_batch:
-                status, payload, error, degraded = (
+                status, payload, error, degraded, cached = (
                     http_api.solve_batch_route(node, body)
                 )
                 self._record("/solve_batch", t0, error=error)
-                return status, payload, False, degraded
+                return status, payload, False, degraded, cached
             if (
                 path == "/debug/flightrecord"
                 and getattr(node, "flight", None) is not None
             ):
                 status, payload, _error = http_api.flightrecord_route(node)
-                return status, payload, False, False
+                return status, payload, False, False, False
             # unknown POST path: the stock handler never reads these
             # bodies and must close; this transport already consumed the
             # body, but it keeps the same observable contract
-            return 404, {"error": "Invalid endpoint"}, True, False
+            return 404, {"error": "Invalid endpoint"}, True, False, False
         if method == b"GET":
             if path == "/stats":
                 return (
@@ -404,21 +407,32 @@ class FastHTTPServer:
                     http_api.stats_payload(node, self.expose_serving),
                     False,
                     False,
+                    False,
                 )
             if path == "/network":
-                return 200, node.network_view(), False, False
+                return 200, node.network_view(), False, False, False
             if path == "/metrics" and self.expose_metrics:
-                return 200, http_api.metrics_payload(node), False, False
+                return (
+                    200, http_api.metrics_payload(node), False, False,
+                    False,
+                )
             if path in http_api.PROM_PATHS and self.expose_metrics:
                 # Prometheus exposition — the shared core renders it, so
                 # the bytes match the stock transport's exactly
-                return 200, http_api.metrics_prom_payload(node), False, False
+                return (
+                    200, http_api.metrics_prom_payload(node), False,
+                    False, False,
+                )
             if path == http_api.CLUSTER_PATH and self.expose_metrics:
                 # the gossip-aggregated fleet view (ISSUE 10)
-                return 200, http_api.cluster_payload(node), False, False
+                return (
+                    200, http_api.cluster_payload(node), False, False,
+                    False,
+                )
             if path in http_api.CLUSTER_PROM_PATHS and self.expose_metrics:
                 return (
-                    200, http_api.cluster_prom_payload(node), False, False,
+                    200, http_api.cluster_prom_payload(node), False,
+                    False, False,
                 )
             if (
                 path == "/debug/trace"
@@ -426,13 +440,16 @@ class FastHTTPServer:
             ):
                 # the span ring as Perfetto-loadable trace-event JSON
                 status, payload, _error = http_api.trace_export_route(node)
-                return status, payload, False, False
+                return status, payload, False, False, False
             if path == "/healthz":
-                return 200, http_api.healthz_payload(node), False, False
+                return (
+                    200, http_api.healthz_payload(node), False, False,
+                    False,
+                )
             if path == "/readyz":
                 status, payload = http_api.readyz_route(node)
-                return status, payload, False, False
-        return 404, {"error": "Invalid endpoint"}, False, False
+                return status, payload, False, False, False
+        return 404, {"error": "Invalid endpoint"}, False, False, False
 
     def _record(
         self, route: str, t0: float, error: bool = False, shed: bool = False
@@ -443,7 +460,7 @@ class FastHTTPServer:
     @staticmethod
     def _reply(
         conn, status: int, payload, *, close: bool, degraded: bool = False,
-        request_id=None, timing=None,
+        cached: bool = False, request_id=None, timing=None,
     ) -> None:
         if isinstance(payload, bytes):
             # pre-rendered non-JSON body (the Prometheus exposition)
@@ -457,6 +474,9 @@ class FastHTTPServer:
             # fallback-served answer marker; body stays byte-identical
             # (see http_api.SudokuHTTPHandler._send_response)
             extra = b"X-Degraded: true\r\n" + extra
+        if cached:
+            # answer-cache marker (cache/, ISSUE 13); same contract
+            extra = b"X-Cache: hit\r\n" + extra
         if timing is not None:
             # the opt-in span breakdown (client sent X-Timing)
             extra = b"X-Timing: %s\r\n%s" % (timing.encode(), extra)

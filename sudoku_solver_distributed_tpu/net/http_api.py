@@ -23,13 +23,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 # the exposition content type is defined by the renderer — ONE site
 from ..obs.prom import CONTENT_TYPE as PROM_CONTENT_TYPE
-from ..obs.trace import new_request_id, valid_request_id
+from ..obs.trace import current_trace, new_request_id, valid_request_id
 
 logger = logging.getLogger(__name__)
 
 
 MAX_BATCH = 4096        # board-count guard for /solve_batch
 MAX_BATCH_BYTES = 32 << 20  # body-size guard, checked before buffering
+# largest /solve_batch the answer cache consults/feeds (ISSUE 13): the
+# per-board canonicalization (~0.3-0.5 ms pure Python) is a rounding
+# error on a viral single request but ~2 s of serial handler-thread
+# work on a MAX_BATCH bulk job — and bulk batches are offline
+# throughput traffic, not the duplicated request stream the cache
+# exists for. Larger batches skip the cache entirely (lookup AND
+# store) and behave exactly as the pre-cache path.
+CACHE_BATCH_MAX = 256
 
 
 def _board_error(sudoku, size: int) -> str | None:
@@ -109,6 +117,9 @@ def timing_header_value(record: dict) -> str:
     return json.dumps(
         {
             "total_ms": record["total_ms"],
+            # front-door answer-cache consult (ISSUE 13): canonicalize +
+            # lookup (+ peer fetch wait) — nonzero on hits AND misses
+            "cache_ms": record["cache_ms"],
             "queue_ms": record["queue_ms"],
             "coalesce_ms": record["coalesce_ms"],
             "device_ms": record["device_ms"],
@@ -162,13 +173,83 @@ def retry_after_header(payload) -> str | None:
     return None
 
 
+def _parse_board(p2p_node, body: bytes):
+    """Parse + semantically validate a /solve body. Returns the board
+    list, or None after logging — the shared early step the cache path
+    and the engine core both use (parsed once per request)."""
+    try:
+        sudoku = json.loads(body.decode("utf-8"))["sudoku"]
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+        # TypeError: a JSON-valid non-object body ([1,2,3], "foo") makes
+        # body["sudoku"] a non-subscript access — same 400, never a dead
+        # handler thread (code-review r5)
+        return None
+    reason = _board_error(sudoku, p2p_node.engine.spec.size)
+    if reason is not None:
+        logger.info("rejected /solve body: %s", reason)
+        return None
+    return sudoku
+
+
+def _cache_lookup(p2p_node, sudoku, deadline_ms=None):
+    """Front-door cache consult (cache/, ISSUE 13): local lookup, then —
+    on a miss for a key some fresh peer's hot-set gossip advertises — a
+    bounded peer fetch (verified on arrival) before any dispatch.
+    Returns (answer | None, canonical form | None); the elapsed time is
+    stamped as the request span's ``cache`` stage either way, so misses'
+    canonicalization cost is as visible as hits' savings.
+
+    ``deadline_ms`` (the request's relative budget) clamps the peer
+    fetch wait: a request never parks past its own deadline for an
+    answer it could no longer use."""
+    cache = p2p_node.answer_cache
+    t0 = time.monotonic()
+    try:
+        # miss accounting deferred (count_miss=False): the peer-fetch
+        # path probes the store twice for one request, and exactly one
+        # outcome — hit or miss — may land in the counters (a
+        # peer-served request double-counting as miss AND hit would
+        # corrupt hit_rate_pct and the fleet rollup)
+        answer, form = cache.lookup(sudoku, count_miss=False)
+        if answer is None and form is not None:
+            gossip = getattr(p2p_node, "cache_gossip", None)
+            if gossip is not None:
+                budget_s = None
+                if deadline_ms is not None:
+                    budget_s = (
+                        deadline_ms / 1e3 - (time.monotonic() - t0)
+                    )
+                if gossip.try_peer_fetch(form.key, timeout_s=budget_s):
+                    # a verified peer answer just landed under this
+                    # key: re-run the lookup and serve it as a hit
+                    answer, form = cache.lookup(
+                        sudoku, form, count_miss=False
+                    )
+        if answer is None and form is not None:
+            cache._count("misses")
+    finally:
+        tr = current_trace()
+        if tr is not None:
+            tr.mark("cache", time.monotonic() - t0)
+    return answer, form
+
+
 def solve_route(p2p_node, body: bytes, deadline_ms=None):
     """POST /solve: the reference's solve surface (node.py:661-690).
 
-    Returns ``(status, payload, error_flag, degraded)`` — ``degraded``
-    True when the answer came from the supervisor's host-oracle fallback
-    (serving/health.py); transports surface it as the ``X-Degraded``
-    response header, keeping the BODY byte-identical to the reference.
+    Returns ``(status, payload, error_flag, degraded, cached)`` —
+    ``degraded`` True when the answer came from the supervisor's
+    host-oracle fallback (serving/health.py); ``cached`` True when it
+    came from the canonical-form answer cache (cache/, ISSUE 13).
+    Transports surface them as the ``X-Degraded`` / ``X-Cache: hit``
+    response headers, keeping the BODY byte-identical to the reference.
+
+    With a cache attached, the lookup runs BEFORE admission accounting:
+    a hit never enters the pending budget, never feeds the completion-
+    rate estimator (a hot-set storm answering in microseconds must not
+    inflate projected device capacity — the PR 2 malformed-body failure
+    shape), and is counted in the separate ``admission.cache_hits``
+    gauge instead.
 
     ``deadline_ms`` is the request's relative latency budget (the
     ``X-Deadline-Ms`` header, parsed by the transport). With an admission
@@ -180,8 +261,47 @@ def solve_route(p2p_node, body: bytes, deadline_ms=None):
     the pre-admission stack (the header is ignored).
     """
     adm = getattr(p2p_node, "admission", None)
+    cache = getattr(p2p_node, "answer_cache", None)
+    sudoku = None
+    form = None
+    already_expired = (
+        deadline_ms is not None and deadline_ms <= 0
+    )
+    if cache is not None and not already_expired:
+        # (an already-expired budget skips the consult entirely — the
+        # admission layer's microsecond 429 is the cheapest answer a
+        # dead-on-arrival request can get)
+        t_arrival = time.monotonic()
+        sudoku = _parse_board(p2p_node, body)
+        if sudoku is None:
+            if adm is not None:
+                # parsed (and failed) before try_admit ran: keep the
+                # malformed-body flood visible to admission's arrival
+                # rate + rejected counter — pre-cache it was admitted
+                # then released served=False, and an operator's
+                # dashboard must not read an active flood as a quiet
+                # healthy node
+                adm.note_rejected()
+            return 400, {"error": "Invalid request"}, True, False, False
+        answer, form = _cache_lookup(
+            p2p_node, sudoku, deadline_ms=deadline_ms
+        )
+        if answer is not None:
+            if adm is not None:
+                adm.note_cache_hit()
+            return 200, answer, False, False, True
+        if deadline_ms is not None:
+            # the consult (canonicalize + lookup, possibly a bounded
+            # peer-fetch wait) happened before admission: charge it
+            # against the client's budget — the deadline measures the
+            # client's wait, not where the server spent it. A budget
+            # the consult already exhausted sheds at try_admit
+            # (non-positive = expired at arrival)
+            deadline_ms -= (time.monotonic() - t_arrival) * 1e3
     if adm is None:
-        return _solve_core(p2p_node, body, None)
+        return _solve_core(
+            p2p_node, body, None, sudoku=sudoku, form=form
+        )
     decision = adm.try_admit(deadline_ms)
     if not decision.admitted:
         logger.debug("shed /solve at arrival (%s)", decision.reason)
@@ -190,13 +310,17 @@ def solve_route(p2p_node, body: bytes, deadline_ms=None):
             _shed_payload("Overloaded", decision.retry_after_s),
             True,
             False,
+            False,
         )
     from ..serving.admission import DeadlineExceeded
 
     expired = False
     outcome = {"served": False}
     try:
-        return _solve_core(p2p_node, body, decision.deadline_s, outcome)
+        return _solve_core(
+            p2p_node, body, decision.deadline_s, outcome,
+            sudoku=sudoku, form=form,
+        )
     except DeadlineExceeded:
         # admitted in time, overtaken by load: dropped at batch formation
         # (parallel/coalescer.py) — the device never ran it
@@ -205,6 +329,7 @@ def solve_route(p2p_node, body: bytes, deadline_ms=None):
             429,
             _shed_payload("Deadline exceeded", adm.retry_hint_s()),
             True,
+            False,
             False,
         )
     finally:
@@ -215,24 +340,21 @@ def solve_route(p2p_node, body: bytes, deadline_ms=None):
         adm.release(expired=expired, served=outcome["served"])
 
 
-def _solve_core(p2p_node, body: bytes, deadline_s, outcome=None):
+def _solve_core(
+    p2p_node, body: bytes, deadline_s, outcome=None, *,
+    sudoku=None, form=None,
+):
     # debug, not info: two formatted log records per request is measurable
     # GIL time under a 64-client closed loop (the reference logs every
     # request at INFO, but its serving path was never multi-tenant);
     # error paths still log at info
     t_in = time.time()
     logger.debug("received /solve POST request")
-    try:
-        sudoku = json.loads(body.decode("utf-8"))["sudoku"]
-    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
-        # TypeError: a JSON-valid non-object body ([1,2,3], "foo") makes
-        # body["sudoku"] a non-subscript access — same 400, never a dead
-        # handler thread (code-review r5)
-        return 400, {"error": "Invalid request"}, True, False
-    reason = _board_error(sudoku, p2p_node.engine.spec.size)
-    if reason is not None:
-        logger.info("rejected /solve body: %s", reason)
-        return 400, {"error": "Invalid request"}, True, False
+    if sudoku is None:
+        # no cache consult happened upstream: parse here (once)
+        sudoku = _parse_board(p2p_node, body)
+        if sudoku is None:
+            return 400, {"error": "Invalid request"}, True, False, False
     if outcome is not None:
         outcome["served"] = True  # past validation: the engine runs now
     from ..models.oracle import OracleBudgetExceeded
@@ -255,16 +377,26 @@ def _solve_core(p2p_node, body: bytes, deadline_s, outcome=None):
             {"error": "Degraded: fallback budget exceeded"},
             True,
             True,
+            False,
         )
     degraded = bool(info.get("degraded"))
     logger.debug("execution time: %s", time.time() - t_in)
     if solution:
-        return 200, solution, False, degraded
+        cache = getattr(p2p_node, "answer_cache", None)
+        if cache is not None:
+            # write gate: store() re-verifies host-side (clue match +
+            # rule check) before admission — whatever path answered
+            # (device, fallback, farm), a wrong answer cannot enter
+            # (cache/store.py). The canonical form from the lookup is
+            # reused so the reduction is paid once per request.
+            cache.store(sudoku, solution, form)
+        return 200, solution, False, degraded, False
     return (
         400,
         {"error": "No solution found", "solution": solution},
         True,
         degraded,
+        False,
     )
 
 
@@ -277,17 +409,23 @@ def solve_batch_route(p2p_node, body: bytes):
     mean not solved; capped counts rows whose search exhausted the
     iteration budget (not finished ≠ proven unsatisfiable, engine.py).
 
-    Returns ``(status, payload, error_flag, degraded)`` like
+    Returns ``(status, payload, error_flag, degraded, cached)`` like
     ``solve_route`` (ISSUE 12 satellite — the PR 5 known limit closed):
     under an open breaker or a mid-batch device failure the supervised
     engine answers every board from the host-oracle fallback; the reply
     then carries per-board ``degraded`` flags in the body and transports
     surface the any-board summary as ``X-Degraded``, instead of the
-    whole batch erroring."""
+    whole batch erroring.
+
+    With an answer cache attached (cache/, ISSUE 13), cached boards
+    STRIP OUT of the batch before coalescing — only the misses pay
+    admission into the engine's batch path — and their answers merge
+    back in request order. ``cached`` is the any-board summary (the
+    ``X-Cache: hit`` header); the body shape is unchanged."""
     try:
         sudokus = json.loads(body.decode())["sudokus"]
     except (ValueError, KeyError, TypeError, UnicodeDecodeError):
-        return 400, {"error": "Invalid request"}, True, False
+        return 400, {"error": "Invalid request"}, True, False, False
     size = p2p_node.engine.spec.size
     if not isinstance(sudokus, list) or not 1 <= len(sudokus) <= MAX_BATCH:
         reason = f"need 1..{MAX_BATCH} boards"
@@ -297,22 +435,60 @@ def solve_batch_route(p2p_node, body: bytes):
         )
     if reason is not None:
         logger.info("rejected /solve_batch body: %s", reason)
-        return 400, {"error": "Invalid request"}, True, False
-    solutions, mask, info = p2p_node.batch_sudoku_solve(sudokus)
+        return 400, {"error": "Invalid request"}, True, False, False
+    cache = getattr(p2p_node, "answer_cache", None)
+    n = len(sudokus)
+    if cache is not None and n > CACHE_BATCH_MAX:
+        # oversized bulk jobs skip the consult (and the symmetric store
+        # cost below): serial canonicalization of thousands of boards
+        # on the handler thread is a latency regression the
+        # duplicated-request stream this cache serves can never repay
+        # there. Small batches still consult AND warm the cache.
+        cache = None
+    answers = [None] * n
+    forms = [None] * n
+    hit = [False] * n
+    if cache is not None:
+        t0 = time.monotonic()
+        for i, s in enumerate(sudokus):
+            answers[i], forms[i] = cache.lookup(s)
+            hit[i] = answers[i] is not None
+        tr = current_trace()
+        if tr is not None:
+            tr.mark("cache", time.monotonic() - t0)
+    miss_idx = [i for i in range(n) if not hit[i]]
+    degraded = False
+    degraded_rows = [False] * n
+    capped = 0
+    solved = n - len(miss_idx)
+    if miss_idx:
+        solutions, mask, info = p2p_node.batch_sudoku_solve(
+            [sudokus[i] for i in miss_idx]
+        )
+        capped = info["capped"]
+        solved += int(mask.sum())
+        degraded = bool(info.get("degraded"))
+        for pos, i in enumerate(miss_idx):
+            if mask[pos]:
+                answers[i] = solutions[pos].tolist()
+                if cache is not None:
+                    # write-gated like every other path (store verifies
+                    # host-side); the lookup's form is reused
+                    cache.store(sudokus[i], answers[i], forms[i])
+            if degraded:
+                degraded_rows[i] = bool(info["degraded_boards"][pos])
     payload = {
-        "solutions": [
-            sol.tolist() if ok else None
-            for sol, ok in zip(solutions, mask)
-        ],
-        "solved": int(mask.sum()),
-        "capped": info["capped"],
+        "solutions": answers,
+        "solved": solved,
+        "capped": capped,
     }
-    degraded = bool(info.get("degraded"))
     if degraded:
         # per-board flags only when fallback serving actually happened:
         # the healthy-path body stays byte-identical to the pre-PR12 one
-        payload["degraded"] = [bool(d) for d in info["degraded_boards"]]
-    return 200, payload, False, degraded
+        # (cache-stripped boards read False — a cached answer was
+        # verified at write time, never a fallback product)
+        payload["degraded"] = degraded_rows
+    return 200, payload, False, degraded, any(hit)
 
 
 def healthz_payload(p2p_node):
@@ -372,6 +548,18 @@ def metrics_payload(p2p_node):
     eng = getattr(p2p_node, "engine", None)
     if eng is not None and hasattr(eng, "health"):
         body["engine"] = eng.health()
+    answer_cache = getattr(p2p_node, "answer_cache", None)
+    if answer_cache is not None and isinstance(
+        body.get("engine", {}).get("cost"), dict
+    ):
+        # the canonical-form answer cache's counters (cache/, ISSUE 13)
+        # live under engine.cost: cache hits ARE device cost avoided,
+        # and the cost block is where an operator reads serving spend
+        snap = answer_cache.snapshot()
+        gossip = getattr(p2p_node, "cache_gossip", None)
+        if gossip is not None:
+            snap["gossip"] = gossip.snapshot()
+        body["engine"]["cost"]["cache"] = snap
     m_health = getattr(
         getattr(p2p_node, "membership", None), "health", None
     )
@@ -546,6 +734,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
         status: int = 200,
         degraded: bool = False,
         timing=None,
+        cached: bool = False,
     ) -> None:
         if isinstance(content, bytes):
             # pre-rendered non-JSON body (the Prometheus exposition)
@@ -567,6 +756,13 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             # reference while clients/operators can still see the answer
             # came from the host-oracle fallback
             self.send_header("X-Degraded", "true")
+        if cached:
+            # the answer-cache marker (cache/, ISSUE 13): same
+            # header-not-body contract — the solution grid is
+            # byte-identical whether it came from the device or the
+            # canonical-form cache, and that identity is the A/B
+            # acceptance (bench.py --mode cache)
+            self.send_header("X-Cache", "hit")
         if status == 429:
             retry = retry_after_header(content)
             if retry is not None:
@@ -618,7 +814,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
                 return
             trace = start_trace(self.p2p_node, "/solve", self._req_id)
             try:
-                status, payload, error, degraded = solve_route(
+                status, payload, error, degraded, cached = solve_route(
                     self.p2p_node, post_data,
                     deadline_ms=_parse_deadline_ms(
                         self.headers.get("X-Deadline-Ms")
@@ -638,7 +834,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             shed = status == 429
             self._record("/solve", t0, error=error and not shed, shed=shed)
             self._send_response(
-                payload, status, degraded=degraded,
+                payload, status, degraded=degraded, cached=cached,
                 timing=timing_header_value(record)
                 if record is not None and self._want_timing
                 else None,
@@ -653,8 +849,8 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
                 self.p2p_node, "/solve_batch", self._req_id
             )
             try:
-                status, payload, error, degraded = solve_batch_route(
-                    self.p2p_node, post_data
+                status, payload, error, degraded, cached = (
+                    solve_batch_route(self.p2p_node, post_data)
                 )
             except BaseException:
                 finish_trace(self.p2p_node, trace, 500)
@@ -664,7 +860,7 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             )
             self._record("/solve_batch", t0, error=error)
             self._send_response(
-                payload, status, degraded=degraded,
+                payload, status, degraded=degraded, cached=cached,
                 timing=timing_header_value(record)
                 if record is not None and self._want_timing
                 else None,

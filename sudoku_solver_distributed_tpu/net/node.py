@@ -101,6 +101,13 @@ class P2PNode:
         self.telemetry = None
         # SLO burn-rate engine (obs/slo.py, CLI --slo); None costs nothing
         self.slo = None
+        # canonical-form answer cache (cache/, ISSUE 13): the CLI wires
+        # an AnswerCache (front-door lookup in net/http_api.py) and a
+        # CacheGossip (hot-set piggyback on stats gossip + the
+        # cache_get/cache_answer fetch pair). None — bare library
+        # nodes — costs nothing and keeps wire bytes reference-identical
+        self.answer_cache = None
+        self.cache_gossip = None
 
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.shutdown_flag = False
@@ -249,6 +256,14 @@ class P2PNode:
         telemetry = (
             self.telemetry.digest() if self.telemetry is not None else None
         )
+        # the answer-cache hot-set digest rides the same heartbeat
+        # (cache/gossip.py, rebuilt at most 1/s); None — no cache, or an
+        # empty one — keeps the key off the wire entirely
+        hotset = (
+            self.cache_gossip.digest()
+            if self.cache_gossip is not None
+            else None
+        )
         msg = wire.stats_msg(
             self.id,
             self._solved_count,
@@ -256,6 +271,7 @@ class P2PNode:
             snap,
             health=sup.state if sup is not None else None,
             telemetry=telemetry,
+            hotset=hotset,
         )
         for peer in peers:
             self.send_to(peer, msg)
@@ -312,6 +328,16 @@ class P2PNode:
             return
         if mtype == "stats" and not wire.valid_address(msg.get("origin")):
             logger.warning("dropping stats with invalid origin: %.200r", msg)
+            return
+        if mtype in ("cache_get", "cache_answer") and not (
+            wire.valid_address(msg.get("address"))
+            and isinstance(msg.get("hash"), str)
+            and (
+                mtype != "cache_answer"
+                or ("board" in msg and "solution" in msg)
+            )
+        ):
+            logger.warning("dropping malformed %s: %.200r", mtype, msg)
             return
         if mtype == "all_peers" and not isinstance(
             msg.get("all_peers"), dict
@@ -384,6 +410,12 @@ class P2PNode:
             # PeerTelemetry sanitizes at the boundary — hostile digests
             # are dropped whole, never partially folded
             self.peer_telemetry.note(msg["origin"], msg.get("telemetry"))
+            # answer-cache hot-set piggyback (optional key, ISSUE 13):
+            # same boundary contract (cache/gossip.PeerHotset.sanitize)
+            if self.cache_gossip is not None:
+                self.cache_gossip.note_hotset(
+                    msg["origin"], msg.get("hotset")
+                )
 
         elif mtype == "disconnect":
             if msg["address"] == self.id:
@@ -401,6 +433,23 @@ class P2PNode:
                 )
                 return
             self._on_disconnect(msg, source=source)
+
+        elif mtype == "cache_get":
+            # a peer's answer-cache fetch (ISSUE 13): answered from our
+            # store when we hold the key, silently ignored otherwise
+            # (the sender's bounded wait is the negative reply) — and
+            # ignored entirely on cache-less nodes. The datagram source
+            # rides along so the reply cannot be reflected at a spoofed
+            # address (cache/gossip.py on_cache_get)
+            if self.cache_gossip is not None:
+                self.cache_gossip.on_cache_get(msg, source=source)
+
+        elif mtype == "cache_answer":
+            # a peer's fetch reply: verified through the store's write
+            # gate on arrival (re-canonicalized + rule-checked) before
+            # any waiter is woken — hostile answers are dropped whole
+            if self.cache_gossip is not None:
+                self.cache_gossip.on_cache_answer(msg)
 
         elif mtype == "solve":
             self._on_solve_task(msg)
@@ -453,12 +502,15 @@ class P2PNode:
                         address,
                     )
                     return
-        # a departed peer's health claim — and its telemetry digest —
-        # die with it (a rejoin at the same address starts with a clean
-        # slate); unconditional — a goodbye is authoritative about the
-        # peer whether or not it changed OUR membership view
+        # a departed peer's health claim — and its telemetry digest and
+        # hot-set advertisements — die with it (a rejoin at the same
+        # address starts with a clean slate); unconditional — a goodbye
+        # is authoritative about the peer whether or not it changed OUR
+        # membership view
         self.peer_health.forget(address)
         self.peer_telemetry.forget(address)
+        if self.cache_gossip is not None:
+            self.cache_gossip.forget(address)
         changed, redial = self.membership.on_disconnect(address)
         if changed:
             if self.membership.all_peers:

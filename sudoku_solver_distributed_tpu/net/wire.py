@@ -19,13 +19,21 @@ node's traffic is indistinguishable from the reference's:
               byte-identical, same trailing-optional pattern as
               disconnect's row/col and stats' health)
   stats       {"type", "origin", "solved", "stats": {"address", "validations"},
-               "all_stats"[, "health"][, "telemetry"]} reference node.py:583-592
+               "all_stats"[, "health"][, "telemetry"][, "hotset"]}
+              reference node.py:583-592
               ("health" is this stack's optional supervisor-state
               piggyback — absent unless an EngineSupervisor is attached;
               "telemetry" is the optional fleet-observability digest
               (obs/cluster.py, ISSUE 10) — absent unless the tracing
-              plane publishes one; both trailing, keeping default
+              plane publishes one; "hotset" is the optional answer-cache
+              hot-set digest (cache/gossip.py, ISSUE 13) — absent unless
+              a cache holds entries; all trailing, keeping default
               traffic byte-identical)
+
+Extension pair (this stack only, not reference surfaces — ISSUE 13):
+
+  cache_get    {"type", "hash", "address"}
+  cache_answer {"type", "hash", "board", "solution", "address"}
 """
 
 from __future__ import annotations
@@ -226,6 +234,7 @@ def stats_msg(
     all_stats: Msg,
     health: Optional[str] = None,
     telemetry: Optional[Msg] = None,
+    hotset: Optional[Msg] = None,
 ) -> Msg:
     # ``health`` piggybacks the sender's engine-supervisor state
     # (serving/health.py: "warming"/"healthy"/"degraded"/"lost") on the
@@ -234,10 +243,49 @@ def stats_msg(
     # fleet-observability digest (obs/cluster.py: goodput, stage
     # latencies, shed rate, warm fraction, mesh topology — ISSUE 10) on
     # the same heartbeat so any node can render GET /metrics/cluster.
-    # Both optional-and-trailing like disconnect's row/col — absent keys
+    # ``hotset`` piggybacks the sender's answer-cache hot-set digest
+    # (cache/gossip.py, ISSUE 13: top-K canonical hashes + hit counts)
+    # so peers learn which keys a cache_get to this node would answer.
+    # All optional-and-trailing like disconnect's row/col — absent keys
     # keep the default wire bytes identical to the reference's, and the
-    # four explicit literals keep every variant visible to
+    # eight explicit literals keep every variant visible to
     # analysis/wire_schema.py (a mutated dict would hide the schema).
+    if hotset is None:
+        if health is None and telemetry is None:
+            return {
+                "type": "stats",
+                "origin": origin,
+                "solved": solved,
+                "stats": {"address": origin, "validations": validations},
+                "all_stats": all_stats,
+            }
+        if telemetry is None:
+            return {
+                "type": "stats",
+                "origin": origin,
+                "solved": solved,
+                "stats": {"address": origin, "validations": validations},
+                "all_stats": all_stats,
+                "health": health,
+            }
+        if health is None:
+            return {
+                "type": "stats",
+                "origin": origin,
+                "solved": solved,
+                "stats": {"address": origin, "validations": validations},
+                "all_stats": all_stats,
+                "telemetry": telemetry,
+            }
+        return {
+            "type": "stats",
+            "origin": origin,
+            "solved": solved,
+            "stats": {"address": origin, "validations": validations},
+            "all_stats": all_stats,
+            "health": health,
+            "telemetry": telemetry,
+        }
     if health is None and telemetry is None:
         return {
             "type": "stats",
@@ -245,6 +293,7 @@ def stats_msg(
             "solved": solved,
             "stats": {"address": origin, "validations": validations},
             "all_stats": all_stats,
+            "hotset": hotset,
         }
     if telemetry is None:
         return {
@@ -254,6 +303,7 @@ def stats_msg(
             "stats": {"address": origin, "validations": validations},
             "all_stats": all_stats,
             "health": health,
+            "hotset": hotset,
         }
     if health is None:
         return {
@@ -263,6 +313,7 @@ def stats_msg(
             "stats": {"address": origin, "validations": validations},
             "all_stats": all_stats,
             "telemetry": telemetry,
+            "hotset": hotset,
         }
     return {
         "type": "stats",
@@ -272,4 +323,31 @@ def stats_msg(
         "all_stats": all_stats,
         "health": health,
         "telemetry": telemetry,
+        "hotset": hotset,
+    }
+
+
+def cache_get_msg(key_hash: str, self_address: str) -> Msg:
+    # answer-cache peer fetch (cache/gossip.py, ISSUE 13): a node that
+    # missed locally on a canonical key a fresh peer's hot-set digest
+    # advertises asks that peer directly; the peer replies with
+    # cache_answer (or stays silent — the sender's bounded wait is the
+    # negative reply, so spoofed gets cannot be amplified into floods)
+    return {"type": "cache_get", "hash": key_hash, "address": self_address}
+
+
+def cache_answer_msg(
+    key_hash: str, board, solution, self_address: str
+) -> Msg:
+    # the fetch reply: the CANONICAL (board, solution) pair for the
+    # requested key. Receivers never trust the claimed hash — the pair
+    # is re-canonicalized and rule-verified through the store's write
+    # gate on arrival (cache/store.py store_canonical), so a hostile
+    # answer is dropped and counted, never served or cached.
+    return {
+        "type": "cache_answer",
+        "hash": key_hash,
+        "board": board,
+        "solution": solution,
+        "address": self_address,
     }

@@ -111,6 +111,18 @@ def build_digest(node, prev: Optional[tuple] = None) -> tuple:
     if slo is not None:
         digest["slo_fast_burn"] = bool(slo.fast_burn_active())
 
+    cache = getattr(node, "answer_cache", None)
+    if cache is not None:
+        # the answer cache's scalars (ISSUE 13): absolute hit/miss
+        # counts ride the digest (not just the rate) so the fleet
+        # rollup can compute a true fleet-wide hit rate instead of
+        # averaging per-node percentages across unequal traffic
+        snap = cache.snapshot()
+        digest["cache_hits"] = snap["hits"]
+        digest["cache_misses"] = snap["misses"]
+        digest["cache_hit_rate_pct"] = snap["hit_rate_pct"]
+        digest["cache_entries"] = snap["entries"]
+
     return digest, (now, served, shed)
 
 
@@ -199,6 +211,16 @@ def cluster_snapshot(node) -> dict:
         "supervisor_states": states,
         "slo_fast_burn": any(d.get("slo_fast_burn") for d in rows),
     }
+    # fleet answer-cache hit rate (ISSUE 13): summed counts, so a busy
+    # node weighs what it serves — visible from any member the moment
+    # hot-set gossip converges the fleet on a viral puzzle
+    c_hits = sum(int(d.get("cache_hits") or 0) for d in rows)
+    c_misses = sum(int(d.get("cache_misses") or 0) for d in rows)
+    if c_hits + c_misses:
+        fleet["cache_hits"] = c_hits
+        fleet["cache_hit_rate_pct"] = round(
+            100.0 * c_hits / (c_hits + c_misses), 2
+        )
     return {
         "self": {"id": getattr(node, "id", "?"), **self_digest},
         "peers": peers,

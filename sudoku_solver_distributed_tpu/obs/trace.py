@@ -49,8 +49,11 @@ from .histo import RouteMetrics, StageMetrics
 logger = logging.getLogger(__name__)
 
 # stage keys every finished record carries (absent stages render 0.0 so
-# the X-Timing header and flight-recorder rows have a fixed shape)
-STAGES = ("queue", "coalesce", "device", "verify", "fallback")
+# the X-Timing header and flight-recorder rows have a fixed shape).
+# "cache" leads because the front-door answer-cache consult (ISSUE 13,
+# net/http_api.py) happens before a request ever queues — the export
+# timeline lays stages in this order
+STAGES = ("cache", "queue", "coalesce", "device", "verify", "fallback")
 
 # the fixed field order of a finished span record — the flight recorder
 # stores records as flat tuples in THIS order (a tuple of atomics is
@@ -59,7 +62,8 @@ STAGES = ("queue", "coalesce", "device", "verify", "fallback")
 # path at transport rates) and rebuilds dicts only at dump time
 RECORD_FIELDS = (
     "trace_id", "route", "t", "status", "total_ms",
-    "queue_ms", "coalesce_ms", "device_ms", "verify_ms", "fallback_ms",
+    "cache_ms", "queue_ms", "coalesce_ms", "device_ms", "verify_ms",
+    "fallback_ms",
     "bucket", "batch_id", "degraded", "fallback", "farmed", "segments",
 )
 

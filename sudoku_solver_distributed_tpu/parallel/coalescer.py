@@ -84,6 +84,13 @@ _SENTINEL = object()
 # its worst-case bypass by deadline-carrying traffic (liveness floor)
 NO_DEADLINE_HORIZON_S = 60.0
 
+# long-job lane-cap residency threshold (ISSUE 13 satellite): a board
+# still RUNNING after this many segment boundaries counts as a deep
+# resident for --deep-lane-cap accounting. Easy boards resolve within
+# ~one configured segment (ops/config.SEGMENT picked k so they do), so
+# anything alive past a few boundaries is in real search depth.
+DEEP_RESIDENT_SEGMENTS = 4
+
 
 def _resolve(future: Future, result=None, exc=None) -> None:
     """Deliver a result/exception to a future that a CALLER may cancel
@@ -161,6 +168,16 @@ class BatchCoalescer:
         is one in-flight segment at most. Ignored (closed loop kept) when
         the engine has no segment program (pallas backend) or fans out
         through a multi-host mesh_runner.
+      deep_lane_cap: (continuous only; ISSUE 13 satellite — the first
+        slice of the multi-tenant fairness item) bound the lanes a
+        long-running board may occupy: residents alive past
+        ``DEEP_RESIDENT_SEGMENTS`` boundaries count as deep, and when
+        more than ``deep_lane_cap`` of them hold lanes while demand
+        waits, the overage (longest-resident first) is evicted to the
+        existing deep-retry net — the board still answers (on its own
+        thread, prior counters accumulated), but it stops squeezing the
+        pool's refill throughput, trimming the PR 12 recorded 0.85×
+        goodput trade under deep-heavy overload. 0 (default): off.
     """
 
     def __init__(
@@ -175,6 +192,7 @@ class BatchCoalescer:
         max_pending: int = 8192,
         wait_policy=None,
         continuous: bool = False,
+        deep_lane_cap: int = 0,
     ):
         if inflight_depth < 1:
             raise ValueError("inflight_depth must be >= 1")
@@ -230,6 +248,9 @@ class BatchCoalescer:
         self.refills = 0        # boards injected into freed lanes
         self._occupied = 0      # lanes holding a live request (gauge)
         self._retry_threads: list = []  # in-flight capped-lane deep retries
+        # long-job lane cap (ISSUE 13 satellite): see class docstring
+        self.deep_lane_cap = max(0, int(deep_lane_cap))
+        self.deep_evictions = 0  # residents evicted over the cap
 
     def _continuous_active(self) -> bool:
         """Continuous mode is only drivable when the engine actually has
@@ -376,6 +397,8 @@ class BatchCoalescer:
                 out["segments"] = self.segments
                 out["refills"] = self.refills
                 out["active_lanes"] = self._occupied
+                out["deep_lane_cap"] = self.deep_lane_cap
+                out["deep_evictions"] = self.deep_evictions
                 out["segment_width"] = (
                     self._engine.segment_pool_width()
                     if hasattr(self._engine, "segment_pool_width")
@@ -685,6 +708,9 @@ class BatchCoalescer:
         from ..ops.solver import pad_board
 
         slots: list = [None] * width
+        # segments each resident has survived (the --deep-lane-cap
+        # residency clock): reset on inject, bumped per boundary
+        ages = [0] * width
         state = None
         zeros = np.zeros((width, N, N), np.int32)
         pad_np = np.asarray(pad_board(eng.spec))
@@ -768,6 +794,7 @@ class BatchCoalescer:
                 boards_np = zeros.copy()
                 for r, i in zip(take, free_idx):
                     slots[i] = r
+                    ages[i] = 0
                     inject_np[i] = 1
                     boards_np[i] = r.board
                     stale.discard(i)
@@ -871,6 +898,55 @@ class BatchCoalescer:
                     slots[i] = None
                     stale.add(i)
                     self._spawn_deep_retry(r, row.copy())
+                else:
+                    ages[i] += 1
+            # -- long-job lane cap (ISSUE 13 satellite): with demand
+            #    waiting, residents past the deep threshold may hold at
+            #    most deep_lane_cap lanes — the overage (longest-resident
+            #    first) finishes on the deep-retry net instead of
+            #    squeezing the refill throughput for every fresh arrival.
+            #    Only under queue pressure: an idle pool has no one to be
+            #    fair TO, and evicting then would just re-solve the board
+            #    from scratch for nothing.
+            if self.deep_lane_cap > 0:
+                now_d = time.monotonic()
+                with self._cond:
+                    # live demand only: entries whose deadline passed
+                    # mid-segment will 429 at the next boundary's drain
+                    # — evicting a resident's accumulated search to
+                    # seat them would waste both
+                    demand = sum(
+                        1
+                        for r in self._pending
+                        if r.deadline is None or r.deadline >= now_d
+                    )
+                if demand > 0:
+                    deep = [
+                        i
+                        for i, r in enumerate(slots)
+                        if r is not None
+                        and ages[i] >= DEEP_RESIDENT_SEGMENTS
+                    ]
+                    # bounded by UNMET demand as well as the cap: each
+                    # eviction discards the lane's accumulated search
+                    # and re-solves from scratch, so free exactly the
+                    # lanes the queue cannot already fill from
+                    # this boundary's resolved/stale slots — never
+                    # four re-solves to seat one waiting board
+                    free = sum(1 for s in slots if s is None)
+                    overage = min(
+                        len(deep) - self.deep_lane_cap,
+                        max(0, demand - free),
+                    )
+                    if overage > 0:
+                        deep.sort(key=lambda i: -ages[i])
+                        for i in deep[:overage]:
+                            r = slots[i]
+                            slots[i] = None
+                            stale.add(i)
+                            with self._stats_lock:
+                                self.deep_evictions += 1
+                            self._spawn_deep_retry(r, rows[i].copy())
             if resolved_rows:
                 eng._account_coalesced(np.stack(resolved_rows))
             # escalate on an empty boundary, snap back on any progress

@@ -103,6 +103,7 @@ class AdmissionController:
         self.expired = 0   # admitted but dropped/expired before completing
         self.rejected = 0  # admitted but finished without engine service
         self.reanchors = 0  # capacity-estimator resets (regime changes)
+        self.cache_hits = 0  # answered by the front-door cache (ISSUE 13)
         self.arrivals = EwmaRate(tau_s=tau_s)
         # count-based, NOT gap-based: completions fan out in bursts (a
         # coalesced batch resolves 8 futures at once) and a gap EWMA
@@ -189,6 +190,29 @@ class AdmissionController:
             self.reanchors += 1
             self._completions.reanchor()
 
+    def note_rejected(self) -> None:
+        """A request rejected BEFORE admission ran (the cache front door
+        parses bodies ahead of ``try_admit`` — ISSUE 13): keep the
+        arrivals EWMA and the ``rejected`` counter faithful so a
+        malformed-body flood stays visible on the operator surface,
+        without a pending-count round trip (nothing was admitted)."""
+        now = time.monotonic()
+        with self._lock:
+            self.arrivals.observe(now)
+            self.rejected += 1
+
+    def note_cache_hit(self) -> None:
+        """One request answered by the canonical-form answer cache
+        (cache/, ISSUE 13) BEFORE admission accounting. Deliberately a
+        bare gauge: a hit never touches ``pending`` and never feeds the
+        completion-rate estimator — a hot-set storm answers in
+        microseconds, and folding those into the measured completion
+        rate would inflate the projected device capacity and over-admit
+        device-bound work (the same failure shape as the PR 2
+        malformed-body fix, from the opposite direction)."""
+        with self._lock:
+            self.cache_hits += 1
+
     def release(self, *, expired: bool = False, served: bool = True) -> None:
         """One admitted request finished (solved, failed, or expired).
 
@@ -225,6 +249,7 @@ class AdmissionController:
                 "expired": self.expired,
                 "rejected": self.rejected,
                 "reanchors": self.reanchors,
+                "cache_hits": self.cache_hits,
                 "default_deadline_ms": round(
                     (self.default_deadline_s or 0.0) * 1e3, 3
                 ),

@@ -1,0 +1,670 @@
+"""Canonical-form answer cache (ISSUE 13, cache/).
+
+Coverage map:
+
+  * canonicalization — roundtrip identity (apply∘invert == id) and
+    key-equality of randomly symmetry-transformed boards over ALL
+    generators (transpose, band/stack perms, in-band row / in-stack col
+    perms, digit relabeling) at 9×9 and 16×16; determinism; bounded
+    degenerate inputs.
+  * verified store — write gate rejects wrong answers (the
+    poisoned-path shape), hits are proven symmetric + rule-checked (a
+    corrupted entry reads as a miss and drops), LRU bounds hold.
+  * front door — X-Cache: hit on BOTH transports with byte-identical
+    solution bodies, the batch route stripping cached boards out of the
+    engine call, the span's ``cache`` stage, and the admission-hygiene
+    satellite: hits land in ``admission.cache_hits`` and never feed the
+    completion-rate estimator.
+  * fleet convergence — two real-UDP nodes: A solves, its hot-set
+    digest gossips, B answers the symmetric TWIN from a verified peer
+    fetch; hostile hotset digests and hostile cache_answer payloads are
+    dropped whole; fleet hit rate renders at GET /metrics/cluster.
+  * /metrics parity — the ``engine.cost.cache`` block is byte-identical
+    across transports in JSON and prom spellings (the PR 6/10 harness).
+  * long-job lane cap (--deep-lane-cap) — deep residents over the cap
+    evict to the deep-retry net while demand queues, and still answer
+    correctly.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sudoku_solver_distributed_tpu.cache import (
+    AnswerCache,
+    CacheGossip,
+    PeerHotset,
+)
+from sudoku_solver_distributed_tpu.cache.canonical import (
+    canonicalize,
+    random_symmetry,
+)
+from sudoku_solver_distributed_tpu.engine import SolverEngine
+from sudoku_solver_distributed_tpu.models import generate_batch
+from sudoku_solver_distributed_tpu.models.oracle import (
+    oracle_is_valid_solution,
+    oracle_solve,
+)
+from sudoku_solver_distributed_tpu.net import http_api, wire
+from sudoku_solver_distributed_tpu.net.http_api import make_http_server
+from sudoku_solver_distributed_tpu.net.node import P2PNode
+
+
+def free_udp_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def post(port, path, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers=headers or {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = SolverEngine(buckets=(1, 4), coalesce=True)
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+def _attach_cache(node, **kw):
+    node.answer_cache = AnswerCache(capacity=kw.pop("capacity", 128))
+    node.cache_gossip = CacheGossip(node.answer_cache, node, **kw)
+    return node
+
+
+# -- canonicalization ---------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "size,holes,count",
+    [(9, 30, 12), (9, 64, 8), (16, 140, 4)],
+    ids=["9x9", "9x9-deep", "16x16"],
+)
+def test_canonical_roundtrip_and_symmetry_key_equality(size, holes, count):
+    """The tentpole property pair: (a) apply∘invert is the identity —
+    the transform really is the receipt; (b) every randomly
+    symmetry-transformed twin (all generators composed) lands on the
+    SAME canonical key, and its own transform maps it onto the same
+    canonical grid."""
+    boards = generate_batch(count, holes, size=size, seed=1301)
+    rng = np.random.default_rng(1302)
+    for board in boards:
+        form = canonicalize(board)
+        assert np.array_equal(form.transform.apply(board), form.grid)
+        assert np.array_equal(
+            form.transform.invert(form.grid), np.asarray(board)
+        )
+        for _ in range(4):
+            twin = random_symmetry(board, rng)
+            tform = canonicalize(twin)
+            assert tform.key == form.key, "symmetric twin missed"
+            assert np.array_equal(tform.grid, form.grid)
+            assert np.array_equal(tform.transform.apply(twin), tform.grid)
+            assert np.array_equal(
+                tform.transform.invert(tform.grid), np.asarray(twin)
+            )
+
+
+def test_canonical_solution_transport():
+    """The serving contract: a solution of the canonical board, pushed
+    back through the requester's inverse transform, solves the
+    requester's board — symmetry preserves sudoku validity."""
+    board = generate_batch(1, 30, size=9, seed=1303, unique=True)[0]
+    twin = random_symmetry(board, np.random.default_rng(4))
+    form = canonicalize(twin)
+    canon_solution = np.asarray(oracle_solve(form.grid.tolist()), np.int32)
+    answer = form.transform.invert(canon_solution)
+    assert oracle_is_valid_solution(answer.tolist())
+    tw = np.asarray(twin)
+    assert bool((answer[tw > 0] == tw[tw > 0]).all())
+
+
+def test_canonical_deterministic_and_degenerate_inputs():
+    board = generate_batch(1, 30, size=9, seed=1304)[0]
+    assert canonicalize(board).key == canonicalize(board).key
+    # all-ties inputs stay bounded and deterministic
+    empty = [[0] * 9 for _ in range(9)]
+    k1 = canonicalize(empty).key
+    assert canonicalize([r[:] for r in empty]).key == k1
+    with pytest.raises(ValueError):
+        canonicalize([[1, 2], [3, 4], [5, 6]])  # not square
+    with pytest.raises(ValueError):
+        canonicalize([[0] * 8 for _ in range(8)])  # 8 not a square edge
+
+
+# -- verified store -----------------------------------------------------------
+
+
+def test_store_write_gate_rejects_wrong_answers():
+    """Poisoning is impossible by construction: a corrupted or
+    clue-breaking 'solution' never enters, whatever produced it."""
+    cache = AnswerCache(capacity=16)
+    board = generate_batch(1, 30, size=9, seed=1305, unique=True)[0]
+    good = oracle_solve(board.tolist())
+    bad = [row[:] for row in good]
+    bad[0][0], bad[0][1] = bad[0][1], bad[0][0]  # rule-breaking swap
+    assert cache.store(board, bad) is False
+    assert cache.store(board, None) is False
+    assert len(cache) == 0 and cache.rejected_writes >= 1
+    assert cache.store(board, good) is True
+    answer, _form = cache.lookup(board)
+    assert answer == good
+    assert cache.snapshot()["hits"] == 1
+
+
+def test_store_hit_serves_symmetric_twin_and_counts():
+    cache = AnswerCache(capacity=16)
+    board = generate_batch(1, 30, size=9, seed=1306, unique=True)[0]
+    cache.store(board, oracle_solve(board.tolist()))
+    twin = random_symmetry(board, np.random.default_rng(5))
+    answer, _form = cache.lookup(twin)
+    assert answer is not None
+    assert oracle_is_valid_solution(answer)
+    tw = np.asarray(twin)
+    ans = np.asarray(answer)
+    assert bool((ans[tw > 0] == tw[tw > 0]).all())
+    snap = cache.snapshot()
+    assert snap["hits"] == 1 and snap["entries"] == 1
+
+
+def test_store_corrupted_entry_reads_as_miss_and_drops():
+    cache = AnswerCache(capacity=16)
+    board = generate_batch(1, 30, size=9, seed=1307, unique=True)[0]
+    cache.store(board, oracle_solve(board.tolist()))
+    key = canonicalize(board).key
+    entry = cache._maps[cache._shard(key)][key]
+    entry.solution = entry.solution.copy()
+    entry.solution[0, 0] = entry.solution[0, 1]  # corrupt in place
+    answer, _form = cache.lookup(board)
+    assert answer is None
+    assert cache.hit_mismatches == 1
+    assert not cache.contains(key)  # dropped, not left to mislead again
+
+
+def test_store_lru_bounds_and_eviction():
+    cache = AnswerCache(capacity=8, shards=2)
+    boards = generate_batch(16, 30, size=9, seed=1308)
+    stored = 0
+    for b in boards:
+        sol = oracle_solve(b.tolist())
+        if sol is not None:
+            stored += cache.store(b, sol)
+    assert stored > 8
+    assert len(cache) <= 8
+    assert cache.snapshot()["evictions"] >= stored - 8
+
+
+def test_hot_set_ranking():
+    cache = AnswerCache(capacity=16)
+    boards = generate_batch(3, 30, size=9, seed=1309)
+    for b in boards:
+        cache.store(b, oracle_solve(b.tolist()))
+    for _ in range(3):
+        cache.lookup(boards[2])
+    hot = cache.hot_set(2)
+    assert len(hot) == 2
+    assert hot[0][0] == canonicalize(boards[2]).key
+    assert hot[0][1] >= 3
+
+
+# -- front door ---------------------------------------------------------------
+
+
+def test_x_cache_header_and_identical_bodies_both_transports(engine):
+    """Second request (and a symmetric twin) hit on both transports;
+    the solution BODY is byte-identical hit vs miss — the cache changes
+    where the answer comes from, never what it is."""
+    board = generate_batch(1, 30, size=9, seed=1310, unique=True)[0]
+    twin = random_symmetry(board, np.random.default_rng(6))
+    for legacy in (False, True):
+        node = _attach_cache(
+            P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+        )
+        httpd = make_http_server(
+            node, "127.0.0.1", 0, expose_batch=True,
+            legacy_transport=legacy,
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            port = httpd.server_address[1]
+            _s, h1, body1 = post(port, "/solve", {"sudoku": board.tolist()})
+            assert h1.get("X-Cache") is None
+            _s, h2, body2 = post(port, "/solve", {"sudoku": board.tolist()})
+            assert h2.get("X-Cache") == "hit"
+            assert body1 == body2  # byte-identical
+            _s, h3, body3 = post(port, "/solve", {"sudoku": twin})
+            assert h3.get("X-Cache") == "hit"
+            sol = json.loads(body3)
+            assert oracle_is_valid_solution(sol)
+            tw = np.asarray(twin)
+            assert bool(
+                (np.asarray(sol)[tw > 0] == tw[tw > 0]).all()
+            )
+        finally:
+            httpd.shutdown()
+
+
+def test_batch_route_strips_cached_boards(engine):
+    """Cached boards never reach the engine's batch path: the node-level
+    batch call sees only the misses, and the merged body keeps request
+    order."""
+    boards = generate_batch(3, 30, size=9, seed=1311, unique=True)
+    node = _attach_cache(
+        P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+    )
+    # prime one entry through the front door
+    status, _p, _e, _d, cached = http_api.solve_route(
+        node, json.dumps({"sudoku": boards[0].tolist()}).encode()
+    )
+    assert status == 200 and not cached
+    seen = []
+    real = node.batch_sudoku_solve
+
+    def spying(sudokus):
+        seen.append(len(sudokus))
+        return real(sudokus)
+
+    node.batch_sudoku_solve = spying
+    twin = random_symmetry(boards[0], np.random.default_rng(7))
+    body = json.dumps(
+        {"sudokus": [boards[1].tolist(), twin, boards[2].tolist()]}
+    ).encode()
+    status, payload, _e, _d, cached = http_api.solve_batch_route(node, body)
+    assert status == 200 and cached is True
+    assert seen == [2]  # the cached twin stripped before coalescing
+    assert payload["solved"] == 3
+    for i, b in enumerate([boards[1], np.asarray(twin), boards[2]]):
+        sol = np.asarray(payload["solutions"][i])
+        assert oracle_is_valid_solution(sol.tolist())
+        assert bool((sol[b > 0] == b[b > 0]).all())
+    # an all-cached batch never calls the engine at all
+    status, payload, _e, _d, cached = http_api.solve_batch_route(node, body)
+    assert status == 200 and cached and payload["solved"] == 3
+    assert seen == [2]
+
+
+def test_cache_stage_in_timing_header(engine):
+    from sudoku_solver_distributed_tpu.obs import Tracer
+
+    tracer = Tracer()
+    node = _attach_cache(
+        P2PNode(
+            "127.0.0.1", free_udp_port(), engine=engine,
+            metrics=tracer.routes,
+        )
+    )
+    node.tracer = tracer
+    httpd = make_http_server(node, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        port = httpd.server_address[1]
+        board = generate_batch(1, 30, size=9, seed=1312)[0].tolist()
+        _s, h, _b = post(
+            port, "/solve", {"sudoku": board}, {"X-Timing": "1"}
+        )
+        miss = json.loads(h["X-Timing"])
+        assert miss["cache_ms"] > 0  # canonicalize cost visible on a miss
+        _s, h, _b = post(
+            port, "/solve", {"sudoku": board}, {"X-Timing": "1"}
+        )
+        hit = json.loads(h["X-Timing"])
+        assert hit["cache_ms"] > 0
+        assert hit["device_ms"] == 0.0  # the device never ran
+    finally:
+        httpd.shutdown()
+
+
+def test_admission_hygiene_cache_hits_do_not_feed_capacity(engine):
+    """The satellite: hits count in admission.cache_hits, never in the
+    completion-rate estimator or the pending budget — a hot-set storm
+    must not inflate projected device capacity (the PR 2 malformed-body
+    failure shape)."""
+    from sudoku_solver_distributed_tpu.serving import AdmissionController
+
+    adm = AdmissionController(capacity=8)
+    node = _attach_cache(
+        P2PNode(
+            "127.0.0.1", free_udp_port(), engine=engine, admission=adm
+        )
+    )
+    board = generate_batch(1, 30, size=9, seed=1313, unique=True)[0]
+    body = json.dumps({"sudoku": board.tolist()}).encode()
+    status, _p, _e, _d, cached = http_api.solve_route(node, body)
+    assert status == 200 and not cached
+    base = adm.snapshot()
+    assert base["completed"] == 1  # the miss fed the estimator once
+    for _ in range(5):
+        status, _p, _e, _d, cached = http_api.solve_route(node, body)
+        assert status == 200 and cached
+    snap = adm.snapshot()
+    assert snap["cache_hits"] == 5
+    assert snap["completed"] == base["completed"]  # hits never fed it
+    assert snap["admitted"] == base["admitted"]    # nor the budget
+    assert snap["pending"] == 0
+
+
+# -- fleet convergence --------------------------------------------------------
+
+
+def test_two_node_convergence_peer_fetch_and_fleet_hit_rate(engine):
+    """The acceptance demo: node A solves, its hot-set digest rides
+    stats gossip, node B answers the symmetric TWIN from a verified
+    peer fetch without dispatching — and the fleet hit rate renders at
+    GET /metrics/cluster."""
+    from sudoku_solver_distributed_tpu.obs import Tracer
+    from sudoku_solver_distributed_tpu.obs.cluster import (
+        TelemetryPublisher,
+    )
+
+    a = P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+    b = P2PNode(
+        "127.0.0.1", free_udp_port(), anchor_node=a.id, engine=engine
+    )
+    for n in (a, b):
+        _attach_cache(n, min_interval_s=0.1)
+    # B publishes telemetry so A's cluster view carries B's cache row
+    tracer_b = Tracer()
+    b.tracer = tracer_b
+    b.metrics = tracer_b.routes
+    b.telemetry = TelemetryPublisher(b, min_interval_s=0.1)
+    threads = [
+        threading.Thread(target=n.run, daemon=True) for n in (a, b)
+    ]
+    for t in threads:
+        t.start()
+    httpd = make_http_server(a, "127.0.0.1", 0, expose_metrics=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        board = generate_batch(1, 30, size=9, seed=1314, unique=True)[0]
+        status, payload, _e, _d, cached = http_api.solve_route(
+            a, json.dumps({"sudoku": board.tolist()}).encode()
+        )
+        assert status == 200 and not cached
+        key = canonicalize(board).key
+        assert wait_for(
+            lambda: b.cache_gossip.peers.holders(key), timeout=15.0
+        ), "hot-set digest never gossiped"
+        # B answers the twin via cache_get/cache_answer — no dispatch
+        twin = random_symmetry(board, np.random.default_rng(8))
+        solves_before = b.engine.cost.snapshot()["dispatches"]
+        status, payload, _e, _d, cached = http_api.solve_route(
+            b, json.dumps({"sudoku": twin}).encode()
+        )
+        assert status == 200 and cached is True
+        assert oracle_is_valid_solution(payload)
+        assert b.engine.cost.snapshot()["dispatches"] == solves_before
+        snap = b.answer_cache.snapshot()
+        assert snap["peer_fetches"] >= 1 and snap["peer_answers"] >= 1
+        # exactly ONE outcome per request: the peer-served request is a
+        # hit, not a miss-and-hit (code-review: the double probe must
+        # not corrupt hit_rate_pct / the fleet rollup)
+        assert snap["hits"] == 1 and snap["misses"] == 0, snap
+        # fleet rollup: B's hit reaches A's cluster view over gossip
+        def fleet_sees_hit():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.server_address[1]}"
+                "/metrics/cluster",
+                timeout=5,
+            ) as r:
+                view = json.loads(r.read())
+            return view["fleet"].get("cache_hits", 0) >= 1 and (
+                "cache_hit_rate_pct" in view["fleet"]
+            )
+
+        assert wait_for(fleet_sees_hit, timeout=15.0), (
+            "fleet hit rate never rendered at /metrics/cluster"
+        )
+    finally:
+        httpd.shutdown()
+        a.shutdown()
+        b.shutdown_flag = True
+        for t in threads:
+            t.join(timeout=3)
+
+
+def test_hostile_hotset_and_cache_answer_rejected(engine):
+    """Ingress hardening: malformed hot-set digests are dropped whole,
+    and a hostile cache_answer (wrong solution / mismatched board) is
+    counted and NEVER cached or served."""
+    hs = PeerHotset()
+    good_key = "a" * 64
+    hs.note("p:1", {"v": 1, "keys": [[good_key, 3]]})
+    assert hs.holders(good_key) == ["p:1"]
+    for bad in (
+        None,
+        "x",
+        {"v": 1, "keys": "nope"},
+        {"v": 1, "keys": [["short", 1]]},
+        {"v": 1, "keys": [[good_key, -1]]},
+        {"v": 1, "keys": [[good_key, True]]},
+        {"v": 1, "keys": [[good_key.upper(), 1]]},
+        {"v": 1, "keys": [[good_key, 1]] * 40},
+    ):
+        hs.note("p:2", bad)
+    assert hs.holders(good_key) == ["p:1"]
+
+    node = _attach_cache(
+        P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+    )
+
+    def arm_waiter(k):
+        # cache_answer folds are SOLICITED-only: register the fetch
+        # waiter the real try_peer_fetch would have, so the write gate
+        # (not the solicitation gate) is what each delivery exercises
+        with node.cache_gossip._waiters_lock:
+            node.cache_gossip._waiters[k] = (threading.Event(), 1)
+
+    board = generate_batch(1, 30, size=9, seed=1315, unique=True)[0]
+    sol = oracle_solve(board.tolist())
+    bad_sol = [row[:] for row in sol]
+    bad_sol[0][0], bad_sol[0][1] = bad_sol[0][1], bad_sol[0][0]
+    key = canonicalize(board).key
+    arm_waiter(key)
+    node.handle_message(
+        wire.decode_msg(
+            wire.encode_msg(
+                wire.cache_answer_msg(
+                    key, board.tolist(), bad_sol, "127.0.0.1:7001"
+                )
+            )
+        ),
+        source=("127.0.0.1", 7001),
+    )
+    assert len(node.answer_cache) == 0
+    assert node.answer_cache.peer_rejects == 1
+    # a Latin-square payload with a non-perfect-square edge passes the
+    # row/col checks but has no box structure: counted-and-dropped,
+    # never an exception out of the UDP loop (code-review finding)
+    arm_waiter("b" * 64)
+    node.handle_message(
+        wire.cache_answer_msg(
+            "b" * 64,
+            [[0, 0, 0]] * 3,
+            [[1, 2, 3], [2, 3, 1], [3, 1, 2]],
+            "127.0.0.1:7001",
+        ),
+        source=("127.0.0.1", 7001),
+    )
+    assert len(node.answer_cache) == 0
+    assert node.answer_cache.peer_rejects == 2
+    # out-of-range cells must be counted-and-dropped, not raise out of
+    # canonicalize (-999 was an IndexError; -1..-9 aliased the relabel
+    # table silently) — code-review finding, round 3
+    empty_j = next(j for j, v in enumerate(board.tolist()[0]) if v == 0)
+    for bad_cell in (-999, -1):
+        hostile = [row[:] for row in board.tolist()]
+        hostile[0][empty_j] = bad_cell
+        arm_waiter("c" * 64)
+        node.handle_message(
+            wire.cache_answer_msg(
+                "c" * 64, hostile, sol, "127.0.0.1:7001"
+            ),
+            source=("127.0.0.1", 7001),
+        )
+    assert len(node.answer_cache) == 0
+    assert node.answer_cache.peer_rejects == 4
+    # UNSOLICITED answers — even valid ones — drop before verification:
+    # an attacker streaming mintable (board, solution) pairs must not
+    # flush the LRU or burn canonicalize time on the UDP loop thread
+    node.handle_message(
+        wire.cache_answer_msg(
+            "d" * 64, board.tolist(), sol, "127.0.0.1:7001"
+        ),
+        source=("127.0.0.1", 7001),
+    )
+    assert len(node.answer_cache) == 0
+    assert node.cache_gossip.unsolicited_answers == 1
+    # the honest SOLICITED pair folds fine — under OUR computed key
+    arm_waiter(key)
+    node.handle_message(
+        wire.cache_answer_msg(key, board.tolist(), sol, "127.0.0.1:7001"),
+        source=("127.0.0.1", 7001),
+    )
+    assert node.answer_cache.contains(key)
+    # reflection guard: a cache_get whose claimed address does not
+    # match its UDP source gets NO reply — the multi-KB positive
+    # answer must not be reflectable at a spoofed victim
+    sent = []
+    node._raw_send = lambda addr, msg: sent.append((addr, msg))
+    node.handle_message(
+        wire.cache_get_msg(key, "10.9.9.9:7001"),
+        source=("127.0.0.1", 7001),
+    )
+    assert sent == []
+    node.handle_message(
+        wire.cache_get_msg(key, "127.0.0.1:7001"),
+        source=("127.0.0.1", 7001),
+    )
+    assert [m["type"] for _a, m in sent] == ["cache_answer"]
+
+
+def test_cache_messages_ignored_without_cache(engine, caplog):
+    """A cache-less node drops the pair silently — no crash, no state."""
+    import logging
+
+    node = P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+    with caplog.at_level(
+        logging.WARNING, logger="sudoku_solver_distributed_tpu.net.node"
+    ):
+        node.handle_message(
+            wire.cache_get_msg("a" * 64, "127.0.0.1:7001"),
+            source=("127.0.0.1", 7001),
+        )
+        node.handle_message(
+            wire.cache_answer_msg(
+                "a" * 64, [[0] * 9] * 9, [[1] * 9] * 9, "127.0.0.1:7001"
+            ),
+            source=("127.0.0.1", 7001),
+        )
+    assert not [r for r in caplog.records if "dropping" in r.getMessage()]
+
+
+# -- /metrics parity ----------------------------------------------------------
+
+
+def test_metrics_cache_block_json_prom_parity(engine):
+    """The PR 6/10 parity harness extended to the cache block: both
+    transports serve byte-identical JSON and prom bodies, and the cache
+    gauges flatten into the exposition."""
+    node = _attach_cache(
+        P2PNode("127.0.0.1", free_udp_port(), engine=engine)
+    )
+    board = generate_batch(1, 30, size=9, seed=1316, unique=True)[0]
+    body = json.dumps({"sudoku": board.tolist()}).encode()
+    http_api.solve_route(node, body)
+    http_api.solve_route(node, body)  # one miss, one hit
+    fast = make_http_server(node, "127.0.0.1", 0, expose_metrics=True)
+    legacy = make_http_server(
+        node, "127.0.0.1", 0, expose_metrics=True, legacy_transport=True
+    )
+    for s in (fast, legacy):
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    try:
+        def get(port, path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.read()
+
+        json_fast = get(fast.server_address[1], "/metrics")
+        json_legacy = get(legacy.server_address[1], "/metrics")
+        assert json_fast == json_legacy
+        blk = json.loads(json_fast)["engine"]["cost"]["cache"]
+        assert blk["hits"] == 1 and blk["misses"] == 1
+        assert blk["stores"] == 1 and blk["entries"] == 1
+        assert "gossip" in blk
+        prom_fast = get(fast.server_address[1], "/metrics.prom")
+        prom_legacy = get(legacy.server_address[1], "/metrics.prom")
+        assert prom_fast == prom_legacy
+        text = prom_fast.decode()
+        assert "sudoku_engine_cost_cache_hits 1" in text
+        assert "sudoku_engine_cost_cache_hit_rate_pct" in text
+        assert "sudoku_engine_cost_cache_gossip_peer_serves" in text
+    finally:
+        fast.shutdown()
+        legacy.shutdown()
+
+
+# -- long-job lane cap (--deep-lane-cap) --------------------------------------
+
+
+def test_deep_lane_cap_evicts_residents_under_demand():
+    """With the cap on and demand queued, deep residents past the
+    residency threshold evict to the deep-retry net (freeing lanes for
+    the queue) and still answer correctly."""
+    deep = np.load("benchmarks/corpus_9x9_deep_128.npz")["boards"]
+    easy = generate_batch(12, 30, size=9, seed=1317)
+    eng = SolverEngine(
+        buckets=(1, 4),
+        coalesce_max_batch=4,
+        continuous=True,
+        segment_iters=2,
+        deep_lane_cap=1,
+    )
+    eng.warmup()
+    try:
+        futs = [eng.solve_one_async(deep[i].tolist()) for i in range(4)]
+        # demand: easy boards queue behind the deep-filled pool
+        futs += [eng.solve_one_async(b.tolist()) for b in easy]
+        for f in futs:
+            sol, _info = f.result(timeout=120)
+            assert sol is not None
+            assert oracle_is_valid_solution(sol)
+        co = eng.coalescer
+        assert co.deep_evictions >= 1, co.stats()
+        assert co.stats()["deep_lane_cap"] == 1
+    finally:
+        eng.close()
+
+
+def test_deep_lane_cap_off_by_default():
+    eng = SolverEngine(buckets=(1, 4), coalesce=True)
+    try:
+        assert eng.deep_lane_cap == 0
+        assert eng.coalescer.deep_lane_cap == 0
+    finally:
+        eng.close()

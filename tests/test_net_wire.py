@@ -191,6 +191,163 @@ def test_roundtrip():
     assert wire.decode_msg(wire.encode_msg(msg)) == msg
 
 
+# -- answer-cache wire surfaces (ISSUE 13) -----------------------------------
+
+
+def test_stats_hotset_variant_order_and_backcompat():
+    """The ``hotset`` trailing key composes with health/telemetry in a
+    fixed order, and ABSENT keys keep the stats bytes byte-identical to
+    the reference capture — the PR 5/10 variant contract."""
+    all_stats = {"all": {"solved": 0, "validations": 0}, "nodes": []}
+    base = wire.stats_msg("h:1", 0, 0, all_stats)
+    assert list(base) == ["type", "origin", "solved", "stats", "all_stats"]
+    hot = {"v": 1, "keys": [["a" * 64, 2]]}
+    h = wire.stats_msg("h:1", 0, 0, all_stats, hotset=hot)
+    assert list(h) == [
+        "type", "origin", "solved", "stats", "all_stats", "hotset",
+    ]
+    both = wire.stats_msg(
+        "h:1", 0, 0, all_stats, health="healthy", hotset=hot
+    )
+    assert list(both) == [
+        "type", "origin", "solved", "stats", "all_stats", "health",
+        "hotset",
+    ]
+    everything = wire.stats_msg(
+        "h:1", 0, 0, all_stats, health="lost", telemetry={"v": 1},
+        hotset=hot,
+    )
+    assert list(everything) == [
+        "type", "origin", "solved", "stats", "all_stats", "health",
+        "telemetry", "hotset",
+    ]
+    tel_hot = wire.stats_msg(
+        "h:1", 0, 0, all_stats, telemetry={"v": 1}, hotset=hot
+    )
+    assert list(tel_hot) == [
+        "type", "origin", "solved", "stats", "all_stats", "telemetry",
+        "hotset",
+    ]
+    # codec roundtrip preserves the digest structure exactly
+    rt = wire.decode_msg(wire.encode_msg(everything))
+    assert rt["hotset"] == hot
+    # absent-key back-compat: the no-extras message still matches the
+    # captured reference bytes (see test_captured_stats_golden)
+    assert b"hotset" not in wire.encode_msg(base)
+
+
+def test_cache_get_bytes():
+    key = "ab" * 32
+    got = wire.encode_msg(wire.cache_get_msg(key, "127.0.0.1:7001"))
+    assert got == (
+        b'{"type": "cache_get", "hash": "' + key.encode()
+        + b'", "address": "127.0.0.1:7001"}'
+    )
+
+
+def test_cache_answer_bytes_and_roundtrip():
+    key = "cd" * 32
+    board = [[0, 1], [1, 0]]
+    msg = wire.cache_answer_msg(key, board, board, "127.0.0.1:7002")
+    assert list(msg) == ["type", "hash", "board", "solution", "address"]
+    assert wire.decode_msg(wire.encode_msg(msg)) == msg
+
+
+def test_cache_messages_clear_handler_ingress():
+    """Constructor output passes the handler's ingress validation (no
+    'dropping'/'malformed' warnings) and dispatches into cache state
+    when a cache is attached — the runtime complement of the static
+    wire-schema gate, same contract as ROUNDTRIP_CASES."""
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.cache import (
+        AnswerCache,
+        CacheGossip,
+    )
+    from sudoku_solver_distributed_tpu.models import generate_batch
+    from sudoku_solver_distributed_tpu.models.oracle import oracle_solve
+
+    node = P2PNode(
+        "127.0.0.1", 7991, engine=_InstantEngine(), failure_timeout=0.0
+    )
+    sent = []
+    node._raw_send = lambda addr, msg: sent.append((addr, msg))
+    node.answer_cache = AnswerCache(capacity=8)
+    node.cache_gossip = CacheGossip(node.answer_cache, node)
+    board = generate_batch(1, 30, size=9, seed=77, unique=True)[0]
+    solution = oracle_solve(board.tolist())
+    import logging
+
+    caplog_records = []
+    handler = logging.Handler()
+    handler.emit = lambda record: caplog_records.append(record)
+    log = logging.getLogger("sudoku_solver_distributed_tpu.net.node")
+    log.addHandler(handler)
+    try:
+        # cache_answer → verified fold into the store (solicited-only:
+        # register the fetch waiter the real try_peer_fetch would hold)
+        import threading as _threading
+
+        with node.cache_gossip._waiters_lock:
+            node.cache_gossip._waiters["e" * 64] = (
+                _threading.Event(), 1,
+            )
+        msg = wire.decode_msg(
+            wire.encode_msg(
+                wire.cache_answer_msg(
+                    "e" * 64, board.tolist(), solution, PEER
+                )
+            )
+        )
+        node.handle_message(msg, source=PEER_SRC)
+        assert len(node.answer_cache) == 1
+        from sudoku_solver_distributed_tpu.cache.canonical import (
+            canonicalize,
+        )
+
+        key = canonicalize(board).key
+        assert node.answer_cache.contains(key)
+        # cache_get for the held key → a cache_answer reply with the
+        # canonical pair
+        msg = wire.decode_msg(
+            wire.encode_msg(wire.cache_get_msg(key, PEER))
+        )
+        node.handle_message(msg, source=PEER_SRC)
+        replies = [m for _a, m in sent if m["type"] == "cache_answer"]
+        assert replies and replies[0]["hash"] == key
+        assert np.asarray(replies[0]["solution"]).shape == (9, 9)
+        rejected = [
+            r.getMessage()
+            for r in caplog_records
+            if "dropping" in r.getMessage()
+            or "malformed" in r.getMessage()
+        ]
+        assert rejected == [], rejected
+    finally:
+        log.removeHandler(handler)
+        node.shutdown_flag = True
+
+
+def test_cache_messages_malformed_dropped_at_ingress(quiet_node, caplog):
+    """Hostile shapes die at the boundary like every other message."""
+    for msg in (
+        {"type": "cache_get", "hash": 5, "address": PEER},
+        {"type": "cache_get", "hash": "a" * 64, "address": None},
+        {"type": "cache_answer", "hash": "a" * 64, "address": PEER},
+        {"type": "cache_answer", "hash": [], "board": [], "solution": [],
+         "address": PEER},
+    ):
+        with caplog.at_level(
+            logging.WARNING,
+            logger="sudoku_solver_distributed_tpu.net.node",
+        ):
+            quiet_node.handle_message(msg, source=PEER_SRC)
+    dropped = [
+        r for r in caplog.records if "dropping" in r.getMessage()
+    ]
+    assert len(dropped) == 4
+
+
 def test_parse_address():
     assert wire.parse_address("10.0.0.2:7123") == ("10.0.0.2", 7123)
 

@@ -921,8 +921,8 @@ def test_solve_batch_degraded_answers_boards_not_errors():
         body = json.dumps(
             {"sudokus": [b.tolist() for b in boards]}
         ).encode()
-        status, payload, error, degraded = http_api.solve_batch_route(
-            node, body
+        status, payload, error, degraded, _cached = (
+            http_api.solve_batch_route(node, body)
         )
         assert status == 200 and not error and degraded is True
         assert payload["solved"] == 3
@@ -932,8 +932,8 @@ def test_solve_batch_degraded_answers_boards_not_errors():
         # disappear from healthy bodies again
         inj.clear()
         assert sup.probe() is True
-        status, payload, error, degraded = http_api.solve_batch_route(
-            node, body
+        status, payload, error, degraded, _cached = (
+            http_api.solve_batch_route(node, body)
         )
         assert status == 200 and degraded is False
         assert "degraded" not in payload
