@@ -67,6 +67,16 @@ Modes (BENCH_MODE env var):
     for commonly-answered requests (a cached answer must be
     bit-identical to a computed one). Artifact
     benchmarks/cache_pr13.json; ``--smoke`` for CI.
+  chaos — the fleet autopilot's proof (ISSUE 14): an M-node fleet under
+    open-loop task-farm overload with a worker SIGKILL'd, a worker
+    SIGSTOP/SIGCONT-cycled (the live straggler), and a worker's engine
+    poisoned over POST /debug/faults mid-run; autopilot ON vs
+    --no-autopilot under the identical schedule + fault timeline.
+    Headline: fault-window deadline-conditioned goodput ratio (≥1.2
+    acceptance), plus the SLO fast-burn recover-with-no-operator-action
+    timeline, hedge fired/won/budget counters, and 100% host-side rule
+    verification of every answer in both arms. Artifact
+    benchmarks/chaos_pr14.json; ``--smoke`` for CI.
   tpu-window — first-class claim-window harness (the fold of the
     tpu_session_retry*.sh scanners): scan the relay ports, bake the
     compile plane within a budget, run the headline ladder, and emit a
@@ -3035,6 +3045,588 @@ def main_cache():
         sys.exit(4)
 
 
+def main_chaos():
+    """Kill-N-of-M fleet chaos A/B: the fleet autopilot's proof (ISSUE 14).
+
+    An M-node CLI fleet (anchor + master + workers) serves open-loop
+    overload on the task-farm path while the harness injects the three
+    classic fleet faults mid-run — a SIGKILL'd worker (crash), a
+    SIGSTOP/SIGCONT-cycled worker (the straggling-but-alive node hedging
+    exists for), and a worker whose ENGINE is poisoned through the PR 5
+    fault injector over ``POST /debug/faults`` (silent wrong answers;
+    its supervisor catches them host-side, trips the breaker, and
+    gossips DEGRADED/LOST) — then clears them and watches the fleet
+    recover WITH NO OPERATOR ACTION. Two arms under the identical
+    Poisson schedule and identical fault timeline:
+
+      1. autopilot — the default stack: burn-aware admission tightening,
+         telemetry-weighted farming, hedged dispatch, elastic
+         membership (a fresh joiner boots during recovery and must
+         defer its join until warm);
+      2. baseline — ``--no-autopilot`` on every node: the PR 13 stack
+         (LOST-skip only, sorted farm order, fixed admission budget).
+
+    Both arms run the master with ``--slo`` on short windows
+    (``--slo-windows``) so fast-burn detection AND recovery are
+    observable inside the run; a scraper thread records the burn /
+    budget-scale / hedge-counter timeline at ~2 Hz. GOODPUT is
+    deadline-conditioned (a 200 after the deadline is a wasted farm,
+    not a served user) and reported per phase (healthy / fault /
+    recovery); the headline is the fault-window goodput ratio
+    (acceptance ≥ 1.2×). EVERY 200 body is rule-verified host-side by
+    the harness in both arms — the autopilot must never trade
+    correctness for tail latency — and hedges must stay under the
+    budget (max(1, frac × primaries)).
+
+    Artifact: benchmarks/chaos_pr14.json (BENCH_CHAOS_OUT). ``--smoke``
+    shrinks the fleet and the windows for CI (autopilot-smoke asserts:
+    artifact parses, ≥1 hedge won, fast burn recovered, zero incorrect
+    answers).
+    """
+    import signal
+    import subprocess
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from sudoku_solver_distributed_tpu.models import generate_batch
+
+    smoke = "--smoke" in sys.argv[1:]
+    n_nodes = int(
+        os.environ.get("BENCH_CHAOS_NODES", "4" if smoke else "5")
+    )
+    assert n_nodes >= 4, "chaos mode needs >= 4 nodes (master + 3 peers)"
+    healthy_s = float(
+        os.environ.get("BENCH_CHAOS_HEALTHY_S", "5" if smoke else "8")
+    )
+    fault_s = float(
+        os.environ.get("BENCH_CHAOS_FAULT_S", "9" if smoke else "14")
+    )
+    recovery_s = float(
+        os.environ.get("BENCH_CHAOS_RECOVERY_S", "11" if smoke else "14")
+    )
+    deadline_ms = float(os.environ.get("BENCH_CHAOS_DEADLINE_MS", "2000"))
+    xmult = float(os.environ.get("BENCH_CHAOS_X", "1.5"))
+    holes = int(os.environ.get("BENCH_CHAOS_HOLES", "6"))
+    platform = os.environ.get("BENCH_PLATFORM", "cpu")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base_http = 21000 + os.getpid() % 500
+
+    boards = [
+        b.tolist()
+        for b in generate_batch(8, holes, seed=20260804, unique=True)
+    ]
+    bodies = [json.dumps({"sudoku": b}).encode() for b in boards]
+
+    def board_ok(board, solution):
+        """Host-side rule verification of one served answer: clue match
+        + every row/col/box a permutation of 1..N."""
+        n = len(board)
+        box = int(round(n ** 0.5))
+        full = set(range(1, n + 1))
+        for i in range(n):
+            for j in range(n):
+                if board[i][j] and solution[i][j] != board[i][j]:
+                    return False
+        for i in range(n):
+            if set(solution[i]) != full:
+                return False
+            if {solution[k][i] for k in range(n)} != full:
+                return False
+        for bi in range(0, n, box):
+            for bj in range(0, n, box):
+                cells = {
+                    solution[bi + di][bj + dj]
+                    for di in range(box)
+                    for dj in range(box)
+                }
+                if cells != full:
+                    return False
+        return True
+
+    def scrape(port, path, timeout=5):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return json.loads(r.read())
+
+    def post_faults(port, cmd):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/faults",
+            data=json.dumps(cmd).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def run_arm(arm_name, port_base, autopilot_on):
+        http_ports = [port_base + i for i in range(n_nodes + 1)]
+        udp_ports = [p - 2000 for p in http_ports]
+        master = http_ports[1]
+        common = [
+            "-h", "0", "--platform", platform, "--no-answer-cache",
+            "--buckets", "1,8", "--metrics", "--http-workers", "64",
+            "--failure-timeout", "5",
+        ]
+        if not autopilot_on:
+            common = common + ["--no-autopilot"]
+        procs = {}
+
+        def boot(i, extra, anchor=True):
+            cmd = [
+                sys.executable, os.path.join(repo, "node.py"),
+                "-p", str(http_ports[i]), "-s", str(udp_ports[i]),
+            ] + common + extra
+            if anchor and i > 0:
+                cmd += ["-a", f"127.0.0.1:{udp_ports[0]}"]
+            procs[i] = subprocess.Popen(
+                cmd, cwd=repo,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+
+        results = []       # (t_arrival, status, latency_ms, correct)
+        res_lock = threading.Lock()
+        timeline = []      # scraper rows
+        stop_scraper = threading.Event()
+
+        def post_solve(k, timeout_s):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{master}/solve",
+                data=bodies[k % len(bodies)],
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                    payload = json.loads(r.read())
+                    status = r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code, (time.perf_counter() - t0) * 1e3, True
+            except Exception:
+                return 0, (time.perf_counter() - t0) * 1e3, True
+            ok = isinstance(payload, list) and board_ok(
+                boards[k % len(bodies)], payload
+            )
+            return status, (time.perf_counter() - t0) * 1e3, ok
+
+        try:
+            # anchor first, then the rest (the autopilot arm's joiners
+            # defer their dial until tier-0 warm — elastic membership)
+            boot(0, [])
+            time.sleep(0.3)
+            boot(
+                1,
+                [
+                    "--admission-capacity", "64",
+                    "--default-deadline-ms", str(deadline_ms),
+                    # the objective sits at deadline/4: healthy-phase
+                    # p99 clears it, the fault window breaches it even
+                    # on the hedging arm (a hedged rescue pays ~the
+                    # hedge threshold + a second RTT), so BOTH arms'
+                    # burn timelines are observable — and recovery on
+                    # the autopilot arm is the artifact's proof
+                    "--slo",
+                    f"latency_p99_ms={deadline_ms / 4:g}@99",
+                    "--slo-windows", "4,12",
+                    "--serving-stats",
+                ],
+            )
+            for i in range(2, n_nodes):
+                boot(
+                    i,
+                    [
+                        "--supervise-engine", "--chaos-injector",
+                        "--breaker-threshold", "2",
+                        "--probe-interval-s", "1",
+                    ],
+                )
+            deadline = time.time() + 240
+            for i in range(n_nodes):
+                while True:
+                    if procs[i].poll() is not None:
+                        raise RuntimeError(
+                            f"node {i} exited rc={procs[i].returncode}"
+                        )
+                    try:
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{http_ports[i]}/readyz",
+                            timeout=2,
+                        ) as r:
+                            if r.status == 200:
+                                break
+                    except urllib.error.HTTPError:
+                        pass
+                    except Exception:
+                        pass
+                    if time.time() > deadline:
+                        raise RuntimeError(f"node {i} never became ready")
+                    time.sleep(0.5)
+            # convergence: the master sees all peers
+            while True:
+                try:
+                    view = scrape(master, "/network")
+                    ids = set(view)
+                    for vs in view.values():
+                        ids.update(vs)
+                    if len(ids) >= n_nodes:
+                        break
+                except Exception:
+                    pass
+                if time.time() > deadline:
+                    raise RuntimeError("fleet did not converge")
+                time.sleep(0.5)
+
+            # warm + calibrate the farm path (sequential closed loop)
+            lat = []
+            fast = 0
+            while fast < 3 and time.time() < deadline:
+                status, ms, ok = post_solve(len(lat), 60)
+                assert status == 200 and ok, (
+                    f"warm solve failed: {status}"
+                )
+                lat.append(ms)
+                fast = fast + 1 if ms < 800 else 0
+            cal = lat[-6:]
+            capacity = 1e3 / max(1.0, float(np.mean(cal)))
+            rate = max(2.0, capacity * xmult)
+
+            def scraper():
+                while not stop_scraper.is_set():
+                    row = {"t": time.perf_counter()}
+                    try:
+                        m = scrape(master, "/metrics", timeout=2)
+                        slo_b = m.get("slo", {})
+                        row["fast_burn"] = slo_b.get("fast_burn_active")
+                        row["fast_burn_events"] = slo_b.get(
+                            "fast_burn_events"
+                        )
+                        adm = m.get("admission", {})
+                        row["budget_scale"] = adm.get("budget_scale")
+                        row["pending"] = adm.get("pending")
+                        ap = m.get("autopilot")
+                        if ap:
+                            row["hedges"] = ap["hedge"]["fired"]
+                            row["hedge_wins"] = ap["hedge"]["won"]
+                            row["tightens"] = ap["admission"]["tightens"]
+                        c = scrape(master, "/metrics/cluster", timeout=2)
+                        row["ready_nodes"] = c["fleet"].get("ready_nodes")
+                        row["fleet_nodes"] = c["fleet"].get("nodes")
+                    except Exception:
+                        row["scrape_error"] = True
+                    timeline.append(row)
+                    stop_scraper.wait(0.5)
+
+            scr = threading.Thread(target=scraper, daemon=True)
+            scr.start()
+
+            # one seeded schedule for the whole drive window — identical
+            # across arms by construction
+            drive_s = healthy_s + fault_s + recovery_s
+            n_arr = max(8, int(rate * drive_s))
+            arrivals = (
+                np.random.default_rng(20260804)
+                .exponential(1.0 / rate, size=n_arr)
+                .cumsum()
+            )
+            arrivals = arrivals[arrivals < drive_s]
+
+            t0 = time.perf_counter()
+            t_fault = t0 + healthy_s
+            t_recover = t_fault + fault_s
+
+            def fire(k, at):
+                delay = t0 + at - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t_arr = time.perf_counter() - t0
+                status, ms, ok = post_solve(k, deadline_ms / 1e3 * 3)
+                with res_lock:
+                    results.append((t_arr, status, ms, ok))
+
+            threads = [
+                threading.Thread(target=fire, args=(k, at), daemon=True)
+                for k, at in enumerate(arrivals)
+            ]
+            for t in threads:
+                t.start()
+
+            # fault timeline (identical across arms): kill one worker,
+            # SIGSTOP-cycle another (the straggler), poison a third's
+            # engine through the PR 5 injector — all mid-overload
+            events = []
+
+            def note(ev):
+                events.append(
+                    {"t": round(time.perf_counter() - t0, 3), "event": ev}
+                )
+
+            kill_i = n_nodes - 1
+            stop_i = n_nodes - 2
+            poison_i = 2 if n_nodes > 4 else None
+            while time.perf_counter() < t_fault:
+                time.sleep(0.05)
+            procs[kill_i].kill()
+            note(f"SIGKILL node{kill_i}")
+            if poison_i is not None:
+                try:
+                    post_faults(
+                        http_ports[poison_i], {"poison_bucket": 1}
+                    )
+                    note(f"poison node{poison_i} bucket 1")
+                except Exception as e:
+                    note(f"poison node{poison_i} failed: {e}")
+            # stop/cont cycles until the recovery point
+            stopped = False
+            while time.perf_counter() < t_recover:
+                if not stopped:
+                    procs[stop_i].send_signal(signal.SIGSTOP)
+                    note(f"SIGSTOP node{stop_i}")
+                    stopped = True
+                    t_next = time.perf_counter() + 3.5
+                else:
+                    procs[stop_i].send_signal(signal.SIGCONT)
+                    note(f"SIGCONT node{stop_i}")
+                    stopped = False
+                    t_next = time.perf_counter() + 2.0
+                while (
+                    time.perf_counter() < min(t_next, t_recover)
+                ):
+                    time.sleep(0.05)
+            # recovery: clear every fault; NO operator action touches
+            # admission/routing — the autopilot must do that part
+            if stopped:
+                procs[stop_i].send_signal(signal.SIGCONT)
+                note(f"SIGCONT node{stop_i}")
+            if poison_i is not None:
+                try:
+                    post_faults(http_ports[poison_i], {"clear": True})
+                    note(f"clear node{poison_i} faults")
+                except Exception as e:
+                    note(f"clear node{poison_i} failed: {e}")
+            joiner = None
+            if not smoke:
+                # elastic membership under traffic: a fresh worker boots
+                # during recovery; on the autopilot arm it defers its
+                # join until tier-0 warm, then prewarms
+                boot(n_nodes, [
+                    "--supervise-engine",
+                ])
+                joiner = {"booted_at": round(
+                    time.perf_counter() - t0, 3
+                )}
+                note(f"boot joiner node{n_nodes}")
+
+            for t in threads:
+                t.join(timeout=drive_s + 30)
+            if joiner is not None:
+                jdeadline = time.time() + 60
+                while time.time() < jdeadline:
+                    try:
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{http_ports[n_nodes]}"
+                            f"/readyz",
+                            timeout=2,
+                        ) as r:
+                            if r.status == 200:
+                                joiner["ready_at"] = round(
+                                    time.perf_counter() - t0, 3
+                                )
+                                break
+                    except Exception:
+                        pass
+                    time.sleep(0.5)
+                try:
+                    view = scrape(master, "/network")
+                    ids = set(view)
+                    for vs in view.values():
+                        ids.update(vs)
+                    joiner["in_master_view"] = (
+                        f"127.0.0.1:{udp_ports[n_nodes]}" in ids
+                    )
+                except Exception:
+                    pass
+            # let the burn windows drain past the fault, then read the
+            # final control-plane state
+            settle = time.perf_counter() + (4.0 if smoke else 6.0)
+            while time.perf_counter() < settle:
+                time.sleep(0.25)
+            final = {}
+            try:
+                m = scrape(master, "/metrics")
+                final["slo"] = {
+                    k: m.get("slo", {}).get(k)
+                    for k in (
+                        "fast_burn_active", "fast_burn_events",
+                    )
+                }
+                final["admission"] = {
+                    k: m.get("admission", {}).get(k)
+                    for k in (
+                        "budget_scale", "shed_deadline",
+                        "shed_capacity", "completed", "expired",
+                    )
+                }
+                if m.get("autopilot"):
+                    final["autopilot"] = m["autopilot"]
+                cost = m.get("engine", {}).get("cost", {})
+                if cost.get("farm"):
+                    final["farm_cost"] = cost["farm"]
+            except Exception as e:
+                final["scrape_error"] = repr(e)
+            stop_scraper.set()
+            scr.join(timeout=5)
+
+            # phase split by ARRIVAL time; goodput = 200s answered
+            # within the deadline, over the phase wall
+            def phase(rows, a, b):
+                sel = [r for r in rows if a <= r[0] < b]
+                ok200 = [
+                    r for r in sel if r[1] == 200 and r[2] <= deadline_ms
+                ]
+                late200 = [
+                    r for r in sel if r[1] == 200 and r[2] > deadline_ms
+                ]
+                return {
+                    "offered": len(sel),
+                    "goodput_pps": round(len(ok200) / max(b - a, 1e-6), 2),
+                    "late_200s": len(late200),
+                    "shed": sum(1 for r in sel if r[1] == 429),
+                    "errors": sum(
+                        1 for r in sel if r[1] not in (200, 429)
+                    ),
+                    "p99_ms": round(
+                        float(
+                            np.percentile([r[2] for r in ok200], 99)
+                        ),
+                        1,
+                    )
+                    if ok200
+                    else None,
+                }
+
+            with res_lock:
+                rows = list(results)
+            incorrect = sum(
+                1 for r in rows if r[1] == 200 and not r[3]
+            )
+            arm_out = {
+                "autopilot": autopilot_on,
+                "capacity_pps_est": round(capacity, 2),
+                "offered_rps": round(rate, 2),
+                "phases": {
+                    "healthy": phase(rows, 0.0, healthy_s),
+                    "fault": phase(
+                        rows, healthy_s, healthy_s + fault_s
+                    ),
+                    "recovery": phase(
+                        rows, healthy_s + fault_s, drive_s
+                    ),
+                },
+                "answered_200": sum(1 for r in rows if r[1] == 200),
+                "incorrect_200s": incorrect,
+                "events": events,
+                "final": final,
+                "timeline": timeline[-80:],
+            }
+            if joiner is not None:
+                arm_out["joiner"] = joiner
+            assert incorrect == 0, (
+                f"{arm_name}: {incorrect} rule-invalid answers served"
+            )
+            return arm_out
+        finally:
+            stop_scraper.set()
+            for p in procs.values():
+                try:
+                    p.send_signal(signal.SIGCONT)
+                except Exception:
+                    pass
+                p.terminate()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    on = run_arm("autopilot", base_http, True)
+    off = run_arm("baseline", base_http + 40, False)
+
+    ratio = (
+        round(
+            on["phases"]["fault"]["goodput_pps"]
+            / off["phases"]["fault"]["goodput_pps"],
+            3,
+        )
+        if off["phases"]["fault"]["goodput_pps"]
+        else None
+    )
+    ap_final = on["final"].get("autopilot", {})
+    hedge = ap_final.get("hedge", {})
+    budget_ok = hedge.get("fired", 0) <= max(
+        1, hedge.get("budget_frac", 0.25) * hedge.get(
+            "primary_dispatches", 0
+        )
+    )
+    burn_recovered = (
+        on["final"].get("slo", {}).get("fast_burn_active") is False
+        and (on["final"].get("slo", {}).get("fast_burn_events") or 0) >= 1
+    )
+    record = {
+        "metric": (
+            f"chaos_fault_window_goodput_ratio_{n_nodes}node"
+        ),
+        "value": ratio,
+        "unit": "x",
+        "vs_baseline": ratio,
+        "nodes": n_nodes,
+        "deadline_ms": deadline_ms,
+        "holes": holes,
+        "windows_s": {
+            "healthy": healthy_s, "fault": fault_s,
+            "recovery": recovery_s,
+        },
+        "hedge": {
+            "fired": hedge.get("fired"),
+            "won": hedge.get("won"),
+            "denied_budget": hedge.get("denied_budget"),
+            "late_dups": hedge.get("late_dups"),
+            "primary_dispatches": hedge.get("primary_dispatches"),
+            "budget_ok": budget_ok,
+        },
+        "slo_recovered_no_operator_action": burn_recovered,
+        "admission_tightens": ap_final.get("admission", {}).get(
+            "tightens"
+        ),
+        "incorrect_200s": {
+            "autopilot": on["incorrect_200s"],
+            "baseline": off["incorrect_200s"],
+        },
+        "arms": {"autopilot": on, "baseline": off},
+    }
+    out_path = os.environ.get("BENCH_CHAOS_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1)
+    print(json.dumps({
+        k: v for k, v in record.items() if k != "arms"
+    }))
+    print(
+        f"# chaos: nodes={n_nodes} offered={on['offered_rps']}rps "
+        f"fault-window goodput on={on['phases']['fault']['goodput_pps']} "
+        f"off={off['phases']['fault']['goodput_pps']} ratio={ratio} | "
+        f"hedges fired={hedge.get('fired')} won={hedge.get('won')} "
+        f"late_dups={hedge.get('late_dups')} budget_ok={budget_ok} | "
+        f"tightens={record['admission_tightens']} "
+        f"burn_recovered={burn_recovered} | incorrect on="
+        f"{on['incorrect_200s']} off={off['incorrect_200s']}",
+        file=sys.stderr,
+    )
+
+
 def main_tpu_window():
     """First-class claim-window harness (ISSUE 7): the fold of the ad-hoc
     ``benchmarks/tpu_session_retry*.sh`` scanners into bench.py.
@@ -3973,10 +4565,12 @@ if __name__ == "__main__":
             sys.exit("bench.py: --mode needs a value "
                      "(throughput|latency|farm|concurrent|overload|"
                      "coldstart|obs-overhead|hotloop|continuous|cache|"
-                     "tpu-window|mesh-scaling)")
+                     "chaos|tpu-window|mesh-scaling)")
         mode = argv[idx]
     if mode == "latency":
         main_latency()
+    elif mode == "chaos":
+        main_chaos()
     elif mode == "continuous":
         main_continuous()
     elif mode == "cache":
@@ -4004,7 +4598,7 @@ if __name__ == "__main__":
     elif mode != "throughput":
         sys.exit(f"bench.py: unknown mode {mode!r} "
                  f"(throughput|latency|farm|concurrent|overload|coldstart|"
-                 f"obs-overhead|hotloop|continuous|cache|tpu-window|"
+                 f"obs-overhead|hotloop|continuous|cache|chaos|tpu-window|"
                  f"mesh-scaling)")
     elif os.environ.get("BENCH_CHILD") == "1":
         main()

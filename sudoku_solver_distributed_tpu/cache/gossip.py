@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..net.peermap import PeerMap
+
 logger = logging.getLogger(__name__)
 
 # a canonical key is a 64-char lowercase sha256 hex digest — the ingress
@@ -48,22 +50,17 @@ def valid_key(raw) -> Optional[str]:
     return None
 
 
-class PeerHotset:
+class PeerHotset(PeerMap):
     """Last-known hot-set digest per peer, carried by the ``hotset``
     piggyback on stats gossip. Same evidence-not-membership contract as
-    net/stats.PeerHealth: entries EXPIRE (``ttl_s``), departures forget
+    net/stats.PeerHealth, via the shared base (net/peermap.PeerMap,
+    ISSUE 14): entries EXPIRE (``ttl_s``) — so holders() can never offer
+    a fetch target snapshot() already considers dead — departures forget
     the peer, and both the peer count and the keys-per-peer are bounded
-    with full ingress sanitization — a hostile datagram can neither grow
+    with full ingress sanitization: a hostile datagram can neither grow
     the heap nor plant garbage keys."""
 
-    MAX_ENTRIES = 256   # peers tracked (flood bound, same as PeerHealth)
     MAX_KEYS = 32       # hot keys accepted per peer digest
-
-    def __init__(self, ttl_s: float = 15.0):
-        self.ttl_s = ttl_s
-        self._lock = threading.Lock()
-        # peer -> (frozenset of keys, {key: hits}, monotonic receive t)
-        self._sets: Dict[str, tuple] = {}
 
     @classmethod
     def sanitize(cls, raw) -> Optional[Dict[str, int]]:
@@ -92,60 +89,29 @@ class PeerHotset:
             out[key] = hits
         return out
 
-    def _purge_locked(self, now: float) -> None:
-        """(lock held) Drop expired digests — the ONE expiry rule every
-        reader applies, so holders() can never offer a fetch target
-        snapshot() already considers dead."""
-        for p in [
-            p
-            for p, (_, _, t) in self._sets.items()
-            if now - t > self.ttl_s
-        ]:
-            del self._sets[p]
-
-    def note(self, peer: str, raw) -> None:
-        digest = self.sanitize(raw)
-        if digest is None:
-            return
-        now = time.monotonic()
-        with self._lock:
-            self._sets[peer] = (frozenset(digest), digest, now)
-            if len(self._sets) > self.MAX_ENTRIES:
-                self._purge_locked(now)
-            while len(self._sets) > self.MAX_ENTRIES:
-                oldest = min(
-                    self._sets.items(), key=lambda kv: kv[1][2]
-                )
-                del self._sets[oldest[0]]
-
     def holders(self, key: str) -> List[str]:
         """Peers whose FRESH (unexpired) digest advertises ``key``,
         hottest-first (the advertised hit count ranks fetch targets: a
         peer serving the key thousands of times is the likeliest to
         still hold it and the least bothered by one more get)."""
-        now = time.monotonic()
-        with self._lock:
-            self._purge_locked(now)
-            matches = [
-                (p, hits.get(key, 0))
-                for p, (keys, hits, _) in self._sets.items()
-                if key in keys
-            ]
+        matches = [
+            (p, hits.get(key, 0))
+            for p, (hits, _age) in self.items().items()
+            if key in hits
+        ]
         matches.sort(key=lambda ph: -ph[1])
         return [p for p, _ in matches]
 
-    def forget(self, peer: str) -> None:
-        with self._lock:
-            self._sets.pop(peer, None)
+    def advertised(self) -> Dict[str, Dict[str, int]]:
+        """Every FRESH advertisement: {peer: {key: hits}} — the joiner
+        prewarm's shopping list (CacheGossip.prewarm, ISSUE 14)."""
+        return {p: dict(hits) for p, (hits, _age) in self.items().items()}
 
     def snapshot(self) -> Dict[str, dict]:
-        now = time.monotonic()
-        with self._lock:
-            self._purge_locked(now)
-            return {
-                p: {"age_s": round(now - t, 3), "keys": len(keys)}
-                for p, (keys, _, t) in self._sets.items()
-            }
+        return {
+            p: {"age_s": round(age, 3), "keys": len(hits)}
+            for p, (hits, age) in self.items().items()
+        }
 
 
 class CacheGossip:
@@ -197,6 +163,10 @@ class CacheGossip:
         self.fetches_capped = 0  # misses that skipped the fetch at cap
         self.unsolicited_answers = 0  # answers dropped, no fetch waiting
         self._fetch_rotation = 0  # round-robin over non-top holders
+        # joiner prewarm counters (ISSUE 14 — see prewarm())
+        self.prewarm_runs = 0
+        self.prewarm_requested = 0
+        self.prewarm_landed = 0
         self._digest_lock = threading.Lock()
         self._cached_digest: Optional[dict] = None
         self._cached_at = 0.0
@@ -355,6 +325,106 @@ class CacheGossip:
                     self._waiters[key] = (ev2, count2 - 1)
         return self.cache.contains(key)
 
+    # -- joiner prewarm (ISSUE 14 satellite) -------------------------------
+    def prewarm(
+        self,
+        *,
+        max_keys: int = 64,
+        budget_s: float = 2.0,
+        per_peer: int = 16,
+    ) -> Tuple[int, int]:
+        """Bulk-fetch peers' advertised hot sets on join, instead of
+        converging one front-door miss at a time (PR 13's recorded
+        remaining edge — the natural partner of elastic membership: a
+        node that defers gossip advertisement until it is servable
+        should arrive already holding the fleet's viral answers).
+
+        Bounded on every axis: at most ``max_keys`` keys total (the
+        hottest advertised keys we don't already hold), at most
+        ``per_peer`` gets sent to any one holder, and one total
+        ``budget_s`` wall-clock wait for the whole run. Every reply
+        folds through the store's verified write gate exactly like a
+        front-door fetch (on_cache_answer → store_canonical → _admit:
+        re-canonicalized under OUR key, rule-verified host-side), so a
+        hostile peer can poison nothing — a bad answer is counted and
+        dropped, and the key simply stays cold.
+
+        Returns (requested, landed). Idempotent and safe to call again
+        (e.g. after a partition heals); the autopilot's membership loop
+        runs it once per join (serving/autopilot.py).
+        """
+        t_end = time.monotonic() + max(0.0, budget_s)
+        adv = self.peers.advertised()
+        score: Dict[str, int] = {}
+        holders: Dict[str, List[str]] = {}
+        for peer, keys in adv.items():
+            for k, h in keys.items():
+                if self.cache.contains(k):
+                    continue
+                score[k] = max(score.get(k, 0), h)
+                holders.setdefault(k, []).append(peer)
+        wanted = sorted(score, key=lambda k: (-score[k], k))[
+            : max(0, int(max_keys))
+        ]
+        self.prewarm_runs += 1
+        if not wanted:
+            return 0, 0
+        from ..net import wire
+
+        # register every waiter BEFORE any get goes out (the solicited-
+        # answers gate in on_cache_answer) — same discipline as
+        # try_peer_fetch, shared waiter table
+        events = {}
+        with self._waiters_lock:
+            for k in wanted:
+                ev, count = self._waiters.get(
+                    k, (threading.Event(), 0)
+                )
+                self._waiters[k] = (ev, count + 1)
+                events[k] = ev
+        sent_per_peer: Dict[str, int] = {}
+        try:
+            asked = []
+            for k in wanted:
+                # hottest holder first, skipping peers already at their
+                # per-peer budget — an advertised-everywhere key must
+                # not concentrate the whole run on one node
+                target = None
+                ranked = sorted(
+                    holders[k],
+                    key=lambda p: (-adv[p].get(k, 0), p),
+                )
+                for p in ranked:
+                    if sent_per_peer.get(p, 0) < per_peer:
+                        target = p
+                        break
+                if target is None:
+                    continue
+                sent_per_peer[target] = sent_per_peer.get(target, 0) + 1
+                self.node.send_to(
+                    target, wire.cache_get_msg(k, self.node.id)
+                )
+                asked.append(k)
+            self.prewarm_requested += len(asked)
+            for k in asked:
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                events[k].wait(remaining)
+        finally:
+            with self._waiters_lock:
+                for k in wanted:
+                    ev2, count2 = self._waiters.get(
+                        k, (events[k], 1)
+                    )
+                    if count2 <= 1:
+                        self._waiters.pop(k, None)
+                    else:
+                        self._waiters[k] = (ev2, count2 - 1)
+        landed = sum(1 for k in wanted if self.cache.contains(k))
+        self.prewarm_landed += landed
+        return len(wanted), landed
+
     def forget(self, peer: str) -> None:
         """A departed peer's advertisements die with it."""
         self.peers.forget(peer)
@@ -370,4 +440,8 @@ class CacheGossip:
             "unsolicited_answers": self.unsolicited_answers,
             "top_k": self.top_k,
             "fetch_timeout_ms": round(self.fetch_timeout_s * 1e3, 1),
+            # joiner prewarm (ISSUE 14): bulk hot-set fetch on join
+            "prewarm_runs": self.prewarm_runs,
+            "prewarm_requested": self.prewarm_requested,
+            "prewarm_landed": self.prewarm_landed,
         }

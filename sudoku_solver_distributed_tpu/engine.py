@@ -890,6 +890,17 @@ class SolverEngine:
         if self.supervisor is not None:
             self.supervisor.close()
 
+    def ready(self) -> bool:
+        """Would ``/readyz`` pass: tier-0 warm AND — when a supervisor
+        is attached — not LOST. ONE definition shared by the HTTP
+        readiness route (net/http_api.readyz_route), the telemetry
+        digest's ``ready`` field (obs/cluster.build_digest), and the
+        autopilot's elastic-membership join gate
+        (serving/autopilot.Autopilot.allow_join); a fourth hand-copy of
+        this predicate would eventually disagree with the other three."""
+        sup = self.supervisor
+        return bool(self.warmed and not (sup is not None and sup.is_lost))
+
     def arm_device_trace(self, log_dir: str, calls: int = 4) -> None:
         """Arm the ``jax.profiler`` capture hook (CLI --device-trace-dir):
         the next warmup pass and the first ``calls`` supervised device

@@ -422,6 +422,71 @@ def build_parser() -> argparse.ArgumentParser:
         "incident auto-dump. Requires the tracing plane (not --no-obs)",
     )
     parser.add_argument(
+        "--no-autopilot",
+        action="store_true",
+        help="disable the fleet autopilot (serving/autopilot.py, ISSUE "
+        "14): no burn-aware admission tightening, no telemetry-weighted "
+        "farm ranking, no hedged dispatch, no join deferral — the PR 13 "
+        "serving surface byte-identically. ON by default: the loops "
+        "no-op gracefully when their inputs (SLO engine, admission, "
+        "telemetry) are absent",
+    )
+    parser.add_argument(
+        "--no-autopilot-admission",
+        action="store_true",
+        help="disable ONLY the burn-aware admission loop (an SLO "
+        "fast-burn edge tightening the projected-wait shed budget, "
+        "relaxing with hysteresis on recovery)",
+    )
+    parser.add_argument(
+        "--no-autopilot-farm",
+        action="store_true",
+        help="disable ONLY telemetry-weighted farm ranking (masters "
+        "fall back to the PR 13 sorted dispatch order; the PR 5 "
+        "LOST-skip always applies)",
+    )
+    parser.add_argument(
+        "--no-autopilot-hedge",
+        action="store_true",
+        help="disable ONLY hedged dispatch (a farm cell straggling past "
+        "the measured farm-task p99 is no longer duplicated to an idle "
+        "peer)",
+    )
+    parser.add_argument(
+        "--no-autopilot-join",
+        action="store_true",
+        help="disable ONLY elastic membership (the joiner dials its "
+        "anchor immediately instead of deferring until /readyz would "
+        "pass, and skips the hot-set cache prewarm)",
+    )
+    parser.add_argument(
+        "--hedge-budget-pct",
+        type=float,
+        default=25.0,
+        help="with the autopilot's hedge loop: lifetime hedge dispatches "
+        "stay under this percentage of primary dispatches (floor: one "
+        "outstanding hedge) — the tail-at-scale bound that keeps "
+        "straggler-chasing from amplifying an overload",
+    )
+    parser.add_argument(
+        "--slo-windows",
+        default=None,
+        metavar="SHORT_S,LONG_S",
+        help="with --slo: override the burn-rate window pair in seconds "
+        "(default 300,3600 — the SRE-workbook 5m/1h shape). Short "
+        "windows (e.g. 5,15) make fast-burn detection and recovery "
+        "observable inside a short chaos run (bench.py --mode chaos)",
+    )
+    parser.add_argument(
+        "--chaos-injector",
+        action="store_true",
+        help="arm an engine-seam fault injector (utils/faults."
+        "EngineFaultInjector) and expose POST /debug/faults to drive it "
+        "(fail_next / delay_s / poison_bucket / clear) — the chaos "
+        "bench's remote arming surface. Off by default: the route 404s "
+        "and no injector exists",
+    )
+    parser.add_argument(
         "--slo-fast-burn",
         type=float,
         default=14.4,
@@ -705,12 +770,26 @@ def main(argv=None) -> None:
             # SLO burn-rate engine (ISSUE 10, obs/slo.py): objectives
             # parse at startup (a malformed spec must fail the boot, not
             # the claim window), evaluation rides Tracer.finish
-            from ..obs.slo import SloEngine, parse_slo
+            from ..obs.slo import DEFAULT_WINDOWS_S, SloEngine, parse_slo
 
+            windows = DEFAULT_WINDOWS_S
+            if args.slo_windows:
+                try:
+                    windows = tuple(
+                        float(w) for w in args.slo_windows.split(",")
+                    )
+                    if len(windows) != 2 or min(windows) <= 0:
+                        raise ValueError
+                except ValueError:
+                    raise SystemExit(
+                        f"--slo-windows wants SHORT_S,LONG_S (got "
+                        f"{args.slo_windows!r})"
+                    ) from None
             slo = SloEngine(
                 tracer.stages,
                 [parse_slo(s) for s in args.slo],
                 recorder=flight,
+                windows_s=windows,
                 fast_burn_threshold=args.slo_fast_burn,
             )
             tracer.slo = slo
@@ -790,6 +869,41 @@ def main(argv=None) -> None:
         from ..obs.cluster import TelemetryPublisher
 
         node.telemetry = TelemetryPublisher(node)
+    if args.chaos_injector:
+        # chaos-harness arming surface (ISSUE 14): an engine-seam fault
+        # injector driveable over POST /debug/faults — the PR 5
+        # injectors reachable on a LIVE fleet member, so bench.py
+        # --mode chaos can poison/slow a node's device path mid-run
+        from ..utils.faults import EngineFaultInjector
+
+        engine.fault_injector = EngineFaultInjector()
+        node.chaos_routes = True
+    autopilot = None
+    if not args.no_autopilot:
+        # fleet autopilot (serving/autopilot.py, ISSUE 14; default ON):
+        # burn-aware admission tightening, telemetry-weighted farm
+        # ranking, hedged dispatch, elastic membership. Each loop
+        # no-ops when its inputs are absent (no SLO engine → no
+        # tightening; no telemetry → neutral ranking), and each has its
+        # own escape hatch. The join loop is tied to warmup: a
+        # --no-warmup node never flips tier-0 warm, so deferring its
+        # join on readiness would only burn the defer horizon.
+        from ..serving.autopilot import Autopilot
+
+        autopilot = Autopilot(
+            node,
+            admission=admission,
+            slo=slo,
+            admission_loop=not args.no_autopilot_admission,
+            farm_loop=not args.no_autopilot_farm,
+            hedge_loop=not args.no_autopilot_hedge,
+            join_loop=(
+                not args.no_autopilot_join and not args.no_warmup
+            ),
+            hedge_budget_frac=max(0.0, args.hedge_budget_pct) / 100.0,
+        )
+        node.autopilot = autopilot
+        autopilot.start()
     if flight is not None:
         import signal
 
@@ -861,6 +975,8 @@ def main(argv=None) -> None:
         node.run()
     finally:
         httpd.shutdown()
+        if autopilot is not None:
+            autopilot.close()
         engine.close()  # drain the coalescer (in-flight futures resolve)
         if serving_loop is not None and serving_loop.is_leader:
             serving_loop.stop()

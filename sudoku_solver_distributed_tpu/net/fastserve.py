@@ -396,6 +396,14 @@ class FastHTTPServer:
             ):
                 status, payload, _error = http_api.flightrecord_route(node)
                 return status, payload, False, False, False
+            if path == "/debug/faults" and getattr(
+                node, "chaos_routes", False
+            ):
+                # chaos-harness injector arming (ISSUE 14) — shared core
+                status, payload, _error = http_api.faults_route(
+                    node, body
+                )
+                return status, payload, False, False, False
             # unknown POST path: the stock handler never reads these
             # bodies and must close; this transport already consumed the
             # body, but it keeps the same observable contract

@@ -516,7 +516,13 @@ def readyz_route(p2p_node):
     warmed = bool(getattr(eng, "warmed", False))
     sup = getattr(eng, "supervisor", None)
     lost = bool(sup is not None and sup.is_lost)
-    ready = warmed and not lost
+    # ONE readiness predicate (engine.ready — shared with the telemetry
+    # digest and the autopilot's join gate); the body fields stay the
+    # PR 5 shape byte-for-byte. Duck-typed engines without ready() keep
+    # the full old predicate — warmed AND not lost, never warmed alone
+    ready = bool(eng is not None and eng.ready()) if (
+        hasattr(eng, "ready")
+    ) else (warmed and not lost)
     body = {"ready": ready, "warmed": warmed}
     if sup is not None:
         body["health"] = sup.state
@@ -610,6 +616,14 @@ def metrics_payload(p2p_node):
     slo = getattr(p2p_node, "slo", None)
     if slo is not None:
         body["slo"] = slo.snapshot()
+    # the fleet autopilot (serving/autopilot.py, ISSUE 14): every
+    # control loop's enable flag, knobs, and counters — burn-aware
+    # admission tightening, farm ranking, hedge fired/won/budget,
+    # join deferral + prewarm. Scalar leaves only, so the prom
+    # exposition flattens it byte-identically on both transports.
+    autopilot = getattr(p2p_node, "autopilot", None)
+    if autopilot is not None:
+        body["autopilot"] = autopilot.snapshot()
     return body
 
 
@@ -695,6 +709,47 @@ def flightrecord_route(p2p_node):
     if out["path"] is None:
         body["record"] = out["payload"]
     return 200, body, False
+
+
+def faults_route(p2p_node, body: bytes):
+    """POST /debug/faults (opt-in, CLI ``--chaos-injector``): arm the
+    PR 5 engine-seam fault injector on a LIVE node, so a chaos harness
+    (bench.py --mode chaos) can poison/slow/fail a fleet member's
+    device path mid-run over HTTP instead of needing in-process access.
+    Body: a JSON object with any of ``fail_next`` (int), ``delay_s``
+    (float), ``poison_bucket`` (int width), ``clear`` (bool — disarm
+    everything, applied FIRST so {"clear":true,"delay_s":x} re-arms
+    atomically). Returns (status, payload, error) with the injector's
+    counters, which also live under the ``faults`` /metrics block.
+
+    404 on nodes without the flag — the route does not exist there,
+    exactly like the other opt-in debug surfaces; values are bounded at
+    the boundary (a hostile caller on the debug port can waste the
+    node's time, which is what the flag opts into, but must not be able
+    to crash the route)."""
+    inj = getattr(
+        getattr(p2p_node, "engine", None), "fault_injector", None
+    )
+    if inj is None or not getattr(p2p_node, "chaos_routes", False):
+        return 404, {"error": "Invalid endpoint"}, True
+    try:
+        cmd = json.loads(body.decode("utf-8")) if body else {}
+    except (ValueError, UnicodeDecodeError):
+        return 400, {"error": "Invalid request"}, True
+    if not isinstance(cmd, dict):
+        return 400, {"error": "Invalid request"}, True
+    try:
+        if cmd.get("clear"):
+            inj.clear()
+        if "fail_next" in cmd:
+            inj.arm_fail_next(max(0, min(1_000_000, int(cmd["fail_next"]))))
+        if "delay_s" in cmd:
+            inj.set_delay(max(0.0, min(3600.0, float(cmd["delay_s"]))))
+        if "poison_bucket" in cmd:
+            inj.poison_bucket(int(cmd["poison_bucket"]))
+    except (TypeError, ValueError):
+        return 400, {"error": "Invalid request"}, True
+    return 200, {"ok": True, "counts": inj.counts()}, False
 
 
 class SudokuHTTPHandler(BaseHTTPRequestHandler):
@@ -874,6 +929,19 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             if post_data is None:
                 return
             status, payload, _error = flightrecord_route(self.p2p_node)
+            self._send_response(payload, status)
+        elif (
+            self.path == "/debug/faults"
+            and getattr(self.p2p_node, "chaos_routes", False)
+        ):
+            # chaos-harness injector arming (ISSUE 14; CLI
+            # --chaos-injector) — the PR 5 engine-seam faults over HTTP
+            post_data = self._read_body("/debug/faults", t0)
+            if post_data is None:
+                return
+            status, payload, _error = faults_route(
+                self.p2p_node, post_data
+            )
             self._send_response(payload, status)
         else:
             # unknown POST path: the body was never read — under keep-alive

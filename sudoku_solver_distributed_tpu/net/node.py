@@ -108,6 +108,20 @@ class P2PNode:
         # nodes — costs nothing and keeps wire bytes reference-identical
         self.answer_cache = None
         self.cache_gossip = None
+        # fleet autopilot (serving/autopilot.py, ISSUE 14): the CLI wires
+        # an Autopilot here (default ON, --no-autopilot restores the
+        # PR 13 serving surface byte-identically). When set it drives
+        # telemetry-weighted farm ranking and hedged dispatch in
+        # _farm_solve, and gates the join dial in run(); None — bare
+        # library nodes — keeps every path exactly as before
+        self.autopilot = None
+        # chaos-harness gate (ISSUE 14): POST /debug/faults exists only
+        # when the CLI armed it (--chaos-injector)
+        self.chaos_routes = False
+        # hedge-marked dispatches this WORKER served (wire solve
+        # "hedge" flag) — the receiving end of the tail-at-scale race,
+        # surfaced through the autopilot /metrics block
+        self.hedge_tasks_received = 0
 
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.shutdown_flag = False
@@ -123,7 +137,10 @@ class P2PNode:
         self._state_lock = threading.Lock()
         self._solution_event = threading.Condition(self._state_lock)
         self.task_queue: deque = deque()
-        self.active_tasks: Dict[str, Tuple[int, int, float]] = {}
+        # peer -> (row, col, deadline, dispatch time): the dispatch
+        # timestamp feeds the autopilot's farm-RTT window and the
+        # hedge straggler test (ISSUE 14)
+        self.active_tasks: Dict[str, Tuple[int, int, float, float]] = {}
         self.solution_queue: deque = deque()
 
         # worker-side: dispatched cells are solved on a dedicated thread so
@@ -537,8 +554,15 @@ class P2PNode:
         # poison its own queue with a foreign cell while dropping its own.
         with self._state_lock:
             if address in self.active_tasks:
-                row, col, _ = self.active_tasks.pop(address)
-                self.task_queue.appendleft((row, col))
+                row, col = self.active_tasks.pop(address)[:2]
+                # one copy per cell in the queue: the departed peer may
+                # have held the hedged arm of a cell another peer is
+                # still solving (see the reap loop's same guard)
+                if (row, col) not in self.task_queue and not any(
+                    (c[0], c[1]) == (row, col)
+                    for c in self.active_tasks.values()
+                ):
+                    self.task_queue.appendleft((row, col))
                 self._solution_event.notify_all()
 
     # -- worker side -------------------------------------------------------
@@ -588,6 +612,12 @@ class P2PNode:
         construction, None only if the dispatched board is unsatisfiable.
         """
         row, col, board, origin = msg["row"], msg["col"], msg["sudoku"], msg["address"]
+        if msg.get("hedge") is True:
+            # a tail-at-scale duplicate dispatch (wire solve "hedge",
+            # ISSUE 14): served exactly like a primary — the master's
+            # merge fold dedups whichever answer arrives second — but
+            # counted, so hedge volume is observable on the worker too
+            self.hedge_tasks_received += 1
         # wire-propagated trace context (ISSUE 6): a traced master
         # piggybacks its request's trace id on the dispatch (optional
         # trailing key, validated at this ingress like every other wire
@@ -752,6 +782,17 @@ class P2PNode:
                     if board[i][j] == 0:
                         self.task_queue.append((i, j))
 
+        # fleet-autopilot wiring (serving/autopilot.py, ISSUE 14): with
+        # no autopilot — or its loops disabled — every branch below is
+        # byte-identical to the PR 13 farm (sorted dispatch order, no
+        # hedging, dup datagrams silently skipped but now counted in the
+        # cost plane either way)
+        ap = self.autopilot
+        rank_farm = ap is not None and ap.farm_enabled
+        hedge_on = ap is not None and ap.hedge_enabled
+        # this request's hedge ledger: cell -> {"primary", "hedge"} peer
+        hedged: Dict[Tuple[int, int], Dict[str, str]] = {}
+
         while True:
             # planned dispatches leave the lock region and send after it:
             # a UDP sendto under _state_lock stalls every thread touching
@@ -762,6 +803,14 @@ class P2PNode:
             # can't mutate a message already planned.
             to_send: List[Tuple[str, wire.Msg]] = []
             expired = False
+            # per-round autopilot bookkeeping, flushed AFTER the lock
+            # region (the counters take their own leaf locks, and the
+            # lock discipline here is already the LOCK102 story above)
+            primaries = 0
+            hedges_fired = 0
+            dup_answers = 0
+            rtts: List[float] = []
+            hedge_results: List[bool] = []
             with self._state_lock:
                 # reap deadlined assignments (dead/slow peers: the failure
                 # mode the reference cannot detect, SURVEY.md §3.5)
@@ -778,13 +827,23 @@ class P2PNode:
                     self.active_tasks.clear()
                     expired = True
                 for peer in list(self.active_tasks):
-                    row, col, deadline = self.active_tasks[peer]
+                    row, col, deadline, _t0 = self.active_tasks[peer]
                     if now > deadline:
                         logger.warning(
                             "task (%d,%d) on %s timed out; requeueing", row, col, peer
                         )
                         del self.active_tasks[peer]
-                        self.task_queue.appendleft((row, col))
+                        # requeue at most ONE copy of a cell: with
+                        # hedging a cell can have two assignments, and
+                        # both expiring in one pass (or one expiring
+                        # while the other arm still runs) must not
+                        # duplicate the queue entry — untracked extra
+                        # dispatches outside the hedge ledger/budget
+                        if (row, col) not in self.task_queue and not any(
+                            (c[0], c[1]) == (row, col)
+                            for c in self.active_tasks.values()
+                        ):
+                            self.task_queue.appendleft((row, col))
 
                 # dispatch one cell per idle peer (reference node.py:433-442).
                 # Membership is re-read each round so departures (graceful or
@@ -793,7 +852,13 @@ class P2PNode:
                 # would answer from a slow oracle fallback while their
                 # engine rebuilds, and a requeued cell re-dispatches to a
                 # healthy peer instead (gossip TTL un-skips them if the
-                # claim goes stale).
+                # claim goes stale). With the autopilot's farm loop on,
+                # the binary skip generalizes into a continuous
+                # preference: candidates are ordered by freshness-decayed
+                # load score from the gossip telemetry digests (ISSUE 14)
+                # instead of plain sorted order, so when there are more
+                # idle peers than cells, the loaded/degraded/stale ones
+                # go last.
                 live = set(self.membership.total_peers())
                 usable = {
                     p for p in live if not self.peer_health.is_lost(p)
@@ -801,7 +866,19 @@ class P2PNode:
                 all_workers_gone = not expired and not usable and (
                     self.task_queue or self.active_tasks
                 )
-                for peer in sorted(usable):
+                # ranked only when a dispatch can actually happen: most
+                # rounds are 50 ms wait slices with an empty queue, and
+                # the telemetry snapshot + sort (autopilot + peer-map
+                # leaf locks, acyclic under _state_lock) should not run
+                # there
+                order = ()
+                if self.task_queue:
+                    order = (
+                        ap.rank_farm_peers(usable)
+                        if rank_farm
+                        else sorted(usable)
+                    )
+                for peer in order:
                     if not self.task_queue:
                         break
                     if peer in self.active_tasks:
@@ -814,7 +891,8 @@ class P2PNode:
                     task_deadline = now + TASK_DEADLINE_S
                     if deadline_s is not None:
                         task_deadline = min(task_deadline, deadline_s)
-                    self.active_tasks[peer] = (i, j, task_deadline)
+                    self.active_tasks[peer] = (i, j, task_deadline, now)
+                    primaries += 1
                     to_send.append(
                         (
                             peer,
@@ -824,6 +902,71 @@ class P2PNode:
                             ),
                         )
                     )
+
+                # hedged dispatch (ISSUE 14 — Dean & Barroso's tail at
+                # scale): only once the queue is drained (fresh cells
+                # always outrank duplicates), a cell straggling past the
+                # measured farm-task p99 is raced on the best-ranked
+                # IDLE peer. First verified answer wins; the merge fold
+                # below dedups the loser's late reply; the autopilot's
+                # budget bounds lifetime hedges to a fraction of primary
+                # dispatches so tail-chasing can never amplify overload.
+                if (
+                    hedge_on
+                    and not expired
+                    and not self.task_queue
+                    and self.active_tasks
+                ):
+                    idle = [
+                        p for p in usable if p not in self.active_tasks
+                    ]
+                    # oldest stragglers past the threshold, unhedged —
+                    # found BEFORE any ranking work so the common
+                    # nothing-to-hedge round costs a list scan only
+                    thr = ap.hedge_threshold_s() if idle else None
+                    stragglers = (
+                        [
+                            (peer, task)
+                            for peer, task in sorted(
+                                self.active_tasks.items(),
+                                key=lambda kv: kv[1][3],
+                            )
+                            if (task[0], task[1]) not in hedged
+                            and now - task[3] >= thr
+                        ]
+                        if idle
+                        else []
+                    )
+                    if stragglers:
+                        idle = (
+                            ap.rank_farm_peers(idle)
+                            if rank_farm
+                            else sorted(idle)
+                        )
+                        for peer, task in stragglers:
+                            if not idle:
+                                break
+                            i, j, task_deadline, t0 = task
+                            if not ap.try_hedge():
+                                break  # budget spent this round
+                            target = idle.pop(0)
+                            hedged[(i, j)] = {
+                                "primary": peer, "hedge": target,
+                            }
+                            self.active_tasks[target] = (
+                                i, j, task_deadline, now,
+                            )
+                            hedges_fired += 1
+                            to_send.append(
+                                (
+                                    target,
+                                    wire.solve_msg(
+                                        [list(r) for r in board], i, j,
+                                        self.id, trace=trace_id,
+                                        hedge=True,
+                                    ),
+                                )
+                            )
 
                 # fold in any arrived solutions — the master's MERGE
                 # step: each answer is placement-checked against the
@@ -846,13 +989,42 @@ class P2PNode:
                     cur = self.active_tasks.get(peer)
                     if cur is not None and (cur[0], cur[1]) == (row, col):
                         del self.active_tasks[peer]
+                        # dispatch→fold round trip: the sample stream
+                        # the hedge threshold's p99 is read from
+                        rtts.append(time.monotonic() - cur[3])
                     if value is None:
                         requeued_none = True
                         continue
                     if board[row][col] != 0:
-                        continue  # duplicate/stale answer
+                        # late duplicate ``solution`` — a hedged loser's
+                        # reply or a UDP retransmit. Deduped (the winner
+                        # already merged) and counted EXACTLY ONCE per
+                        # datagram here, in the cost plane and the
+                        # autopilot block; it never touches any
+                        # completion accounting, so hedging cannot
+                        # inflate a measured completion rate (ISSUE 14
+                        # satellite — the PR 2 flood-guard shape)
+                        dup_answers += 1
+                        continue
                     if self._placement_ok(board, row, col, value):
                         board[row][col] = value
+                        h = hedged.get((row, col))
+                        if h is not None and peer in (
+                            h["primary"], h["hedge"]
+                        ):
+                            # first verified answer wins the race
+                            hedge_results.append(peer == h["hedge"])
+                        # retire every OTHER copy of this cell (the
+                        # losing hedge arm / a requeued duplicate): the
+                        # cell is answered, so its straggling copies
+                        # must neither requeue it at their deadline nor
+                        # hold their peers out of fresh dispatches
+                        for loser in [
+                            p
+                            for p, c in self.active_tasks.items()
+                            if (c[0], c[1]) == (row, col)
+                        ]:
+                            del self.active_tasks[loser]
                     else:
                         self.task_queue.appendleft((row, col))
 
@@ -867,6 +1039,26 @@ class P2PNode:
             if folded and req_trace is not None:
                 # merge-step verify time, stamped outside the lock
                 req_trace.mark("verify", fold_s)
+
+            # autopilot + cost-plane bookkeeping, outside _state_lock
+            # (each takes its own leaf lock)
+            if ap is not None:
+                if primaries:
+                    ap.note_primary_dispatch(primaries)
+                for s in rtts:
+                    ap.note_farm_rtt(s)
+                for won in hedge_results:
+                    ap.note_hedge_result(won)
+                for _ in range(dup_answers):
+                    ap.note_late_dup()
+            if primaries or hedges_fired or dup_answers:
+                cost = getattr(self.engine, "cost", None)
+                if cost is not None:
+                    cost.note_farm(
+                        dispatches=primaries,
+                        hedges=hedges_fired,
+                        dup_solutions=dup_answers,
+                    )
 
             for peer, msg in to_send:
                 self.send_to(peer, msg)
@@ -981,22 +1173,48 @@ class P2PNode:
                     not self.membership.neighbors()
                     and time.monotonic() - last_anchor_try > 2.0
                 ):
-                    if self.anchor_node:
-                        self.connect_to_anchor_node()
-                        last_anchor_try = time.monotonic()
-                    # a dead (or absent) anchor must not strand us: after
-                    # each unanswered dial window, also try a remembered
-                    # peer when we know any (the joiner whose anchor died
-                    # mid-handshake — extended soak; ONE shared redial
-                    # site, code-review r5)
-                    target = self.membership.reconnect_candidate()
-                    if target is not None and target != self.anchor_node:
-                        logger.info(
-                            "no neighbors: dialing remembered peer %s",
-                            target,
+                    if (
+                        self.autopilot is not None
+                        and not self.autopilot.allow_join()
+                        and (
+                            self.anchor_node
+                            or self.membership.reconnect_candidate()
+                            is not None
                         )
-                        self.send_to(target, wire.connect_msg(self.id))
+                    ):
+                        # elastic membership (ISSUE 14): defer the join
+                        # dial until /readyz would pass — the engine is
+                        # prewarming tier 0 (from the shared AOT store
+                        # when a compile plane is configured, PR 4), and
+                        # advertising now would draw farm tasks this
+                        # node can only time out. Bounded: allow_join
+                        # opens past the defer horizon regardless, so a
+                        # node that can never warm still joins.
+                        self.autopilot.note_deferred_dial()
                         last_anchor_try = time.monotonic()
+                    else:
+                        if self.anchor_node:
+                            self.connect_to_anchor_node()
+                            last_anchor_try = time.monotonic()
+                        # a dead (or absent) anchor must not strand us:
+                        # after each unanswered dial window, also try a
+                        # remembered peer when we know any (the joiner
+                        # whose anchor died mid-handshake — extended
+                        # soak; ONE shared redial site, code-review r5)
+                        target = self.membership.reconnect_candidate()
+                        if (
+                            target is not None
+                            and target != self.anchor_node
+                        ):
+                            logger.info(
+                                "no neighbors: dialing remembered peer "
+                                "%s",
+                                target,
+                            )
+                            self.send_to(
+                                target, wire.connect_msg(self.id)
+                            )
+                            last_anchor_try = time.monotonic()
                 elif (
                     self.membership.neighbors()
                     and time.monotonic() - last_anchor_try > 2 * ANTI_ENTROPY_S

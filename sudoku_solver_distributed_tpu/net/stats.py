@@ -15,9 +15,9 @@ concurrently with no locks, SURVEY.md §5).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Dict, Optional
 
+from .peermap import PeerMap
 from .wire import Msg
 
 
@@ -121,7 +121,7 @@ class StatsGossip:
     # the reference's observed behavior (SURVEY.md §3.5).
 
 
-class PeerHealth:
+class PeerHealth(PeerMap):
     """Last-known engine-supervisor state per peer, carried by the
     ``health`` piggyback on stats gossip (wire.stats_msg, ISSUE 5).
 
@@ -129,107 +129,51 @@ class PeerHealth:
     (net/node.py _farm_solve): a peer whose device is gone still answers
     correctly — from its oracle fallback — but multi-second slower, and
     a master under a request deadline should prefer peers that aren't
-    rebuilding an engine. Entries are evidence, not membership: they
-    EXPIRE (``ttl_s``) so a stale "lost" claim can never exclude a peer
-    whose gossip we have since stopped hearing health for (e.g. its
-    operator detached the supervisor), and a peer's departure forgets it
-    entirely (the node prunes on disconnect).
+    rebuilding an engine. The TTL'd/bounded/sanitized machinery lives in
+    the shared base (net/peermap.PeerMap, ISSUE 14): a stale "lost"
+    claim expires instead of excluding a peer forever, departures forget
+    the peer, and a spoofed-origin stats flood exhausts a constant.
     """
 
     _STATES = frozenset({"warming", "healthy", "degraded", "lost"})
-    MAX_ENTRIES = 256  # flood bound, same rationale as the PR 1
-    #                    all_peers growth cap: spoofed-origin stats
-    #                    floods must exhaust a constant, not the heap
 
-    def __init__(self, ttl_s: float = 15.0):
-        self.ttl_s = ttl_s
-        self._lock = threading.Lock()
-        self._states: Dict[str, tuple] = {}  # peer -> (state, monotonic t)
-
-    def note(self, peer: str, state) -> None:
-        """Fold one gossip-carried health claim; non-states are ignored
-        at the boundary (hostile datagrams must not grow this map with
-        garbage — same ingress rule as every other wire field), and the
-        map itself is bounded: claims for peers nobody asks about are
-        never read (get/snapshot prune lazily), so a spoofed-origin
-        flood would otherwise accumulate forever."""
-        if state not in self._STATES:
-            return
-        now = time.monotonic()
-        with self._lock:
-            self._states[peer] = (state, now)
-            if len(self._states) > self.MAX_ENTRIES:
-                for p in [
-                    p
-                    for p, (_, t) in self._states.items()
-                    if now - t > self.ttl_s
-                ]:
-                    del self._states[p]
-            while len(self._states) > self.MAX_ENTRIES:
-                # still over after expiry: evict oldest claims — real
-                # neighbors re-gossip within a second, a flood's spoofed
-                # origins never do
-                oldest = min(self._states.items(), key=lambda kv: kv[1][1])
-                del self._states[oldest[0]]
-
-    def get(self, peer: str) -> Optional[str]:
-        """The peer's last-known state, or None when unknown/expired."""
-        now = time.monotonic()
-        with self._lock:
-            entry = self._states.get(peer)
-            if entry is None:
-                return None
-            state, t = entry
-            if now - t > self.ttl_s:
-                del self._states[peer]
-                return None
-            return state
+    @classmethod
+    def sanitize(cls, raw) -> Optional[str]:
+        """Non-states are rejected at the boundary (hostile datagrams
+        must not grow this map with garbage — same ingress rule as every
+        other wire field). The isinstance guard matters: an unhashable
+        payload (a hostile dict in the ``health`` slot) must read as
+        not-a-state, not raise out of the UDP handler."""
+        return raw if isinstance(raw, str) and raw in cls._STATES else None
 
     def is_lost(self, peer: str) -> bool:
         return self.get(peer) == "lost"
 
-    def forget(self, peer: str) -> None:
-        """Departed peers carry no health (rejoiners start fresh)."""
-        with self._lock:
-            self._states.pop(peer, None)
-
     def snapshot(self) -> Dict[str, str]:
         """Unexpired claims, for the /metrics health block."""
-        now = time.monotonic()
-        with self._lock:
-            for peer in [
-                p
-                for p, (_, t) in self._states.items()
-                if now - t > self.ttl_s
-            ]:
-                del self._states[peer]
-            return {p: s for p, (s, _) in self._states.items()}
+        return {p: s for p, (s, _age) in self.items().items()}
 
 
-class PeerTelemetry:
+class PeerTelemetry(PeerMap):
     """Last-known fleet-observability digest per peer, carried by the
     ``telemetry`` piggyback on stats gossip (wire.stats_msg, ISSUE 10) —
     the generalization of :class:`PeerHealth` from one enum to the whole
     per-node digest (goodput, stage latencies, shed rate, warm fraction,
     supervisor state, mesh topology; obs/cluster.py builds it).
 
-    Same evidence-not-membership contract as PeerHealth: entries EXPIRE
-    (``ttl_s``) so a stale digest can never render as live fleet state,
-    departures forget the peer entirely (net/node.py prunes on
-    disconnect/goodbye), and the map is bounded (``MAX_ENTRIES``) with
-    ingress sanitization so a hostile datagram can neither grow the heap
-    nor smuggle arbitrary structure onto the /metrics/cluster surface.
+    Same evidence-not-membership contract, via the shared base
+    (net/peermap.PeerMap): entries EXPIRE so a stale digest can never
+    render as live fleet state, departures forget the peer entirely
+    (net/node.py prunes on disconnect/goodbye), and the map is bounded
+    with ingress sanitization so a hostile datagram can neither grow the
+    heap nor smuggle arbitrary structure onto the /metrics/cluster
+    surface. The fleet autopilot's farm ranking reads the same map
+    (serving/autopilot.py), so every hardening here guards a control
+    loop, not just a dashboard.
     """
 
-    MAX_ENTRIES = 256        # flood bound, same rationale as PeerHealth
     MAX_KEYS = 32            # digest keys accepted per peer
     MAX_STR = 64             # digest string-value length cap
-
-    def __init__(self, ttl_s: float = 15.0):
-        self.ttl_s = ttl_s
-        self._lock = threading.Lock()
-        # peer -> (sanitized digest dict, monotonic receive time)
-        self._digests: Dict[str, tuple] = {}
 
     @classmethod
     def sanitize(cls, raw) -> Optional[dict]:
@@ -256,54 +200,24 @@ class PeerTelemetry:
                 return None
         return out
 
-    def note(self, peer: str, raw) -> None:
-        """Fold one gossip-carried digest; invalid payloads are dropped
-        at the boundary (same ingress rule as every other wire field)."""
-        digest = self.sanitize(raw)
-        if digest is None:
-            return
-        now = time.monotonic()
-        with self._lock:
-            self._digests[peer] = (digest, now)
-            if len(self._digests) > self.MAX_ENTRIES:
-                for p in [
-                    p
-                    for p, (_, t) in self._digests.items()
-                    if now - t > self.ttl_s
-                ]:
-                    del self._digests[p]
-            while len(self._digests) > self.MAX_ENTRIES:
-                oldest = min(
-                    self._digests.items(), key=lambda kv: kv[1][1]
-                )
-                del self._digests[oldest[0]]
-
-    def forget(self, peer: str) -> None:
-        """Departed peers carry no telemetry (rejoiners start fresh)."""
-        with self._lock:
-            self._digests.pop(peer, None)
-
     def snapshot(self) -> Dict[str, dict]:
         """Unexpired digests with their age:
-        {peer: {"age_s": float, "fresh": bool, **digest}} — ``fresh``
+        {peer: {**digest, "age_s": float, "fresh": bool}} — ``fresh``
         marks entries younger than half the TTL (the /metrics/cluster
-        freshness column)."""
-        now = time.monotonic()
-        with self._lock:
-            for peer in [
-                p
-                for p, (_, t) in self._digests.items()
-                if now - t > self.ttl_s
-            ]:
-                del self._digests[peer]
-            return {
-                p: {
-                    "age_s": round(now - t, 3),
-                    "fresh": (now - t) <= self.ttl_s / 2,
-                    **d,
-                }
-                for p, (d, t) in self._digests.items()
+        freshness column). The digest spreads FIRST: age_s/fresh are
+        OUR receive-side bookkeeping, and a peer-supplied key of the
+        same name (sanitize accepts any short scalar key) must never
+        override them — a spoofed negative age would otherwise rank
+        that peer above every honest one in the autopilot's farm
+        scoring forever."""
+        return {
+            p: {
+                **d,
+                "age_s": round(age, 3),
+                "fresh": age <= self.ttl_s / 2,
             }
+            for p, (d, age) in self.items().items()
+        }
 
 
 def serving_snapshot(engine) -> Msg:

@@ -8,8 +8,11 @@ node's traffic is indistinguishable from the reference's:
   connected   {"type", "address"}                      reference node.py:199
   all_peers   {"type", "all_peers"}                    reference node.py:573
   disconnect  {"type", "address"[, "row", "col"]}      reference node.py:652-654
-  solve       {"type", "sudoku", "row", "col", "address"[, "trace"]}
-                                                      reference node.py:441
+  solve       {"type", "sudoku", "row", "col", "address"[, "trace"]
+               [, "hedge"]}                           reference node.py:441
+              ("hedge" marks a tail-at-scale duplicate dispatch —
+              serving/autopilot.py, ISSUE 14; absent on primary
+              dispatches, keeping default traffic byte-identical)
   solution    {"type", "sudoku", "col", "row", "solution", "address"
                [, "trace"]}
               (note: "col" BEFORE "row" — the reference really does emit this
@@ -172,13 +175,36 @@ def solve_msg(
     col: int,
     self_address: str,
     trace: Optional[str] = None,
+    hedge: bool = False,
 ) -> Msg:
     # ``trace`` piggybacks the originating request's trace id (obs/trace.py)
     # on the task dispatch so a worker's farmed-cell span — and the
     # solution it sends back — can be correlated with the master's request
-    # timeline across nodes. Optional-and-trailing like disconnect's
-    # row/col: absent when the master carried no traced request, so the
-    # default wire bytes stay identical to the reference's.
+    # timeline across nodes. ``hedge`` marks a tail-at-scale duplicate
+    # dispatch (serving/autopilot.py, ISSUE 14): the master has already
+    # dispatched this cell to another peer and is racing the straggler —
+    # workers count the flag (net/node.py) so a chaos run's hedge volume
+    # is observable on BOTH ends of the wire. Each optional-and-trailing
+    # like disconnect's row/col: absent by default, so the default wire
+    # bytes stay identical to the reference's; four explicit literals
+    # keep every variant visible to analysis/wire_schema.py.
+    if not hedge:
+        if trace is None:
+            return {
+                "type": "solve",
+                "sudoku": sudoku,
+                "row": row,
+                "col": col,
+                "address": self_address,
+            }
+        return {
+            "type": "solve",
+            "sudoku": sudoku,
+            "row": row,
+            "col": col,
+            "address": self_address,
+            "trace": trace,
+        }
     if trace is None:
         return {
             "type": "solve",
@@ -186,6 +212,7 @@ def solve_msg(
             "row": row,
             "col": col,
             "address": self_address,
+            "hedge": True,
         }
     return {
         "type": "solve",
@@ -194,6 +221,7 @@ def solve_msg(
         "col": col,
         "address": self_address,
         "trace": trace,
+        "hedge": True,
     }
 
 

@@ -106,6 +106,20 @@ def build_digest(node, prev: Optional[tuple] = None) -> tuple:
             digest["pps"] = snap["pps"]
             digest["lane_util_pct"] = snap["lane_util_pct"]
             digest["pad_waste_pct"] = snap["pad_waste_pct"]
+        if hasattr(engine, "ready"):
+            # readiness (ISSUE 14): /readyz's predicate, gossiped so a
+            # farm master — and the autopilot's peer ranking — can
+            # deprioritize a peer whose engine is rebuilding without
+            # waiting for a probe round trip
+            digest["ready"] = bool(engine.ready())
+
+    adm = getattr(node, "admission", None)
+    if adm is not None:
+        # admission backlog (ISSUE 14): the autopilot's "least-loaded
+        # eligible peer" signal for hedge target choice — a bare int
+        # read (the controller's lock guards compound updates; a torn
+        # read here is impossible for a CPython int)
+        digest["pending"] = int(adm.pending)
 
     slo = getattr(node, "slo", None)
     if slo is not None:
@@ -210,6 +224,9 @@ def cluster_snapshot(node) -> dict:
         ),
         "supervisor_states": states,
         "slo_fast_burn": any(d.get("slo_fast_burn") for d in rows),
+        # readiness rollup (ISSUE 14): how many FRESH members would pass
+        # /readyz right now — the chaos bench's recovery gauge
+        "ready_nodes": sum(1 for d in rows if d.get("ready")),
     }
     # fleet answer-cache hit rate (ISSUE 13): summed counts, so a busy
     # node weighs what it serves — visible from any member the moment

@@ -99,6 +99,14 @@ class CostAccounting:
             "lane_steps": 0,
             "idle_lane_steps": 0,
         }
+        # farm-route counters (ISSUE 14): the master's merge fold feeds
+        # these — cell dispatches and hedge duplicates are dispatch-plane
+        # spend, and a LATE duplicate ``solution`` datagram (a hedged
+        # loser's answer or a UDP retransmit) is counted here exactly
+        # once and NEVER as a completion anywhere, so hedging cannot
+        # inflate a measured completion rate (the PR 2 malformed-flood
+        # guard's failure shape, from the dispatch side)
+        self._farm = {"dispatches": 0, "hedges": 0, "dup_solutions": 0}
 
     def record_call(
         self,
@@ -130,6 +138,21 @@ class CostAccounting:
             if deep_retry:
                 b.deep_retries += 1
             b.recent.append((time.monotonic(), device_s, boards))
+
+    def note_farm(
+        self,
+        *,
+        dispatches: int = 0,
+        hedges: int = 0,
+        dup_solutions: int = 0,
+    ) -> None:
+        """Fold farm-route dispatch-plane events (net/node.py
+        ``_farm_solve``): primary cell dispatches, hedge duplicates, and
+        late duplicate solution datagrams (deduped in the merge fold)."""
+        with self._lock:
+            self._farm["dispatches"] += dispatches
+            self._farm["hedges"] += hedges
+            self._farm["dup_solutions"] += dup_solutions
 
     def note_formation(self, wait_s: float, fill: int) -> None:
         """One coalesced batch formed: the oldest rider's queue wait and
@@ -236,6 +259,7 @@ class CostAccounting:
             formation = list(self._formation)
             seg_totals = dict(self._seg_totals)
             segments = list(self._segments)
+            farm = dict(self._farm)
         out = {
             "dispatches": dispatches,
             "boards": boards,
@@ -284,6 +308,11 @@ class CostAccounting:
                 "sustained_occupancy_pct": _pct(rec_occ, rec_slots),
                 "recent_segments": len(rec),
             }
+        if any(farm.values()):
+            # the farm dispatch plane (ISSUE 14): present only once the
+            # node has actually farmed, so single-node /metrics bodies
+            # stay byte-identical to the PR 13 surface
+            out["farm"] = farm
         if formation:
             out["formation"] = {
                 "batches": len(formation),

@@ -175,6 +175,30 @@ class SloEngine:
         }
         self.ticks = 0
         self.fast_burn_events = 0
+        # burn-edge listeners (ISSUE 14): ``fn(active: bool)`` called
+        # OUTSIDE the engine lock on every ANY-objective fast-burn edge
+        # — rising AND falling — so control loops (the autopilot's
+        # burn-aware admission tightening) are event-driven instead of
+        # sampling the gauge and missing a short excursion
+        self._listeners: List = []
+        self._global_active = False
+
+    def add_burn_listener(self, fn) -> None:
+        """Register ``fn(active: bool)`` for fast-burn edges (both
+        directions). Called outside the engine lock — a listener may
+        take its own lock (the autopilot does); never call back into
+        this engine from one."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_burn_listener(self, fn) -> None:
+        """Unregister a burn listener (Autopilot.close — a retired
+        control loop must stop steering admission; idempotent)."""
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
 
     # -- sampling ------------------------------------------------------------
     def maybe_tick(self, now: Optional[float] = None) -> None:
@@ -202,6 +226,8 @@ class SloEngine:
             for o in self.objectives
         )
         fired: List[dict] = []
+        edge: Optional[bool] = None
+        listeners: List = []
         with self._lock:
             self._next_tick = now + self.tick_interval_s
             self._samples.append((now, counts))
@@ -235,6 +261,11 @@ class SloEngine:
                             ),
                         }
                     )
+            now_active = any(self._active.values())
+            if now_active != self._global_active:
+                self._global_active = now_active
+                edge = now_active
+                listeners = list(self._listeners)
         # recorder work OUTSIDE the engine lock (analysis/locks.py
         # discipline — trigger_incident takes the recorder's own lock)
         for detail in fired:
@@ -242,6 +273,14 @@ class SloEngine:
             if self.recorder is not None:
                 self.recorder.note_event("slo-fast-burn", detail)
                 self.recorder.trigger_incident("slo-fast-burn")
+        if edge is not None:
+            # burn-edge listeners, also outside the lock (they take
+            # their own locks — the autopilot tightens admission here)
+            for fn in listeners:
+                try:
+                    fn(edge)
+                except Exception:  # a control hook must not kill sampling
+                    logger.exception("slo burn listener failed")
 
     def _burn_locked(
         self, idx: int, obj: SloObjective, window_s: float, now: float

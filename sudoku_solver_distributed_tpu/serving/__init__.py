@@ -2,7 +2,7 @@
 
 PR 1 made the serving path fast (request coalescing + lean keep-alive
 transport); this package makes it survive being popular — and survive its
-own device. Four pieces:
+own device. Five pieces:
 
   * admission.py — ``AdmissionController``: bounded pending budget +
     per-request deadlines; overload is answered with an honest, cheap
@@ -18,6 +18,10 @@ own device. Four pieces:
     serve from a bounded host-oracle fallback (correct, slower, flagged)
     while half-open probes — verified round-trip solves — re-admit the
     device, and a LOST engine is re-warmed through the compile plane.
+  * autopilot.py — ``Autopilot`` (ISSUE 14): the telemetry plane's
+    closed control loops — burn-aware admission tightening,
+    telemetry-weighted farm ranking, hedged dispatch, elastic
+    membership — the decision layer over everything above.
   * wiring — net/fastserve.py (bounded worker pool), net/http_api.py
     (shared 429 route core, /healthz + /readyz), net/cli.py
     (``--admission-capacity``, ``--default-deadline-ms``,
@@ -31,11 +35,13 @@ byte-identically to the PR 1 stack.
 """
 
 from .admission import AdmissionController, Decision, DeadlineExceeded
+from .autopilot import Autopilot
 from .health import DEGRADED, HEALTHY, LOST, WARMING, EngineSupervisor
 from .load import AdaptiveWaitPolicy, EwmaRate, WindowRate
 
 __all__ = [
     "AdmissionController",
+    "Autopilot",
     "Decision",
     "DeadlineExceeded",
     "EngineSupervisor",

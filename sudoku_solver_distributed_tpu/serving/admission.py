@@ -104,6 +104,16 @@ class AdmissionController:
         self.rejected = 0  # admitted but finished without engine service
         self.reanchors = 0  # capacity-estimator resets (regime changes)
         self.cache_hits = 0  # answered by the front-door cache (ISSUE 13)
+        # burn-aware tightening (ISSUE 14, serving/autopilot.py): the
+        # fraction of each request's deadline budget the projected-wait
+        # shed may consume. 1.0 — the default, and the value every
+        # escape hatch restores — is exactly the PR 2 behavior; the
+        # autopilot lowers it on an SLO fast-burn rising edge so
+        # shedding starts BEFORE the p99 objective is gone, and raises
+        # it back with hysteresis on recovery. Scales only the shed
+        # projection: the client's real deadline (Decision.deadline_s)
+        # is never shortened.
+        self.budget_scale = 1.0
         self.arrivals = EwmaRate(tau_s=tau_s)
         # count-based, NOT gap-based: completions fan out in bursts (a
         # coalesced batch resolves 8 futures at once) and a gap EWMA
@@ -158,7 +168,9 @@ class AdmissionController:
                     retry_after_s=self._retry_after_s(projected),
                     reason="capacity",
                 )
-            if budget_s is not None and (budget_s <= 0 or projected > budget_s):
+            if budget_s is not None and (
+                budget_s <= 0 or projected > budget_s * self.budget_scale
+            ):
                 self.shed_deadline += 1
                 return Decision(
                     False,
@@ -189,6 +201,14 @@ class AdmissionController:
         with self._lock:
             self.reanchors += 1
             self._completions.reanchor()
+
+    def set_budget_scale(self, scale: float) -> None:
+        """Set the burn-aware shed tightening factor (serving/autopilot.py
+        drives this; clamped to [0.05, 1.0] — a control-law bug must
+        never be able to shed everything or loosen past the PR 2
+        contract)."""
+        with self._lock:
+            self.budget_scale = min(1.0, max(0.05, float(scale)))
 
     def note_rejected(self) -> None:
         """A request rejected BEFORE admission ran (the cache front door
@@ -250,6 +270,7 @@ class AdmissionController:
                 "rejected": self.rejected,
                 "reanchors": self.reanchors,
                 "cache_hits": self.cache_hits,
+                "budget_scale": self.budget_scale,
                 "default_deadline_ms": round(
                     (self.default_deadline_s or 0.0) * 1e3, 3
                 ),

@@ -191,6 +191,37 @@ def test_roundtrip():
     assert wire.decode_msg(wire.encode_msg(msg)) == msg
 
 
+def test_solve_hedge_variant_order_and_backcompat():
+    """The ``hedge`` trailing key (ISSUE 14 hedged dispatch) composes
+    with ``trace`` in a fixed order, and ABSENT keys keep the solve
+    bytes byte-identical to the reference capture — the same
+    trailing-optional contract as stats' health/telemetry/hotset."""
+    board = [[0] * 9 for _ in range(9)]
+    base = wire.solve_msg(board, 2, 5, "127.0.0.1:7000")
+    assert list(base) == ["type", "sudoku", "row", "col", "address"]
+    assert b"hedge" not in wire.encode_msg(base)
+    h = wire.solve_msg(board, 2, 5, "127.0.0.1:7000", hedge=True)
+    assert list(h) == [
+        "type", "sudoku", "row", "col", "address", "hedge",
+    ]
+    assert wire.encode_msg(h).endswith(
+        b'"address": "127.0.0.1:7000", "hedge": true}'
+    )
+    both = wire.solve_msg(
+        board, 2, 5, "127.0.0.1:7000", trace=("ab" * 8), hedge=True
+    )
+    assert list(both) == [
+        "type", "sudoku", "row", "col", "address", "trace", "hedge",
+    ]
+    rt = wire.decode_msg(wire.encode_msg(both))
+    assert rt["hedge"] is True and rt["trace"] == "ab" * 8
+    # hedge=False is not "hedge": false on the wire — absent entirely
+    t_only = wire.solve_msg(
+        board, 2, 5, "127.0.0.1:7000", trace=("ab" * 8)
+    )
+    assert "hedge" not in t_only
+
+
 # -- answer-cache wire surfaces (ISSUE 13) -----------------------------------
 
 
@@ -497,6 +528,11 @@ ROUNDTRIP_CASES = [
         _check_disconnect,
     ),
     ("solve", lambda: wire.solve_msg(BOARD9, 0, 0, PEER), _check_solve),
+    (
+        "solve_hedge",
+        lambda: wire.solve_msg(BOARD9, 0, 0, PEER, hedge=True),
+        _check_solve,
+    ),
     (
         "solution",
         lambda: wire.solution_msg(BOARD9, 2, 3, 7, PEER),

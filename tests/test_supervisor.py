@@ -507,7 +507,7 @@ def test_peer_health_map_is_bounded_under_spoofed_flood():
     ph = PeerHealth(ttl_s=600.0)  # nothing expires during the flood
     for k in range(PeerHealth.MAX_ENTRIES + 100):
         ph.note(f"10.0.0.{k}:{k}", "lost")
-    assert len(ph._states) <= PeerHealth.MAX_ENTRIES
+    assert len(ph) <= PeerHealth.MAX_ENTRIES
     # the newest claims survive the eviction
     assert ph.is_lost(f"10.0.0.{PeerHealth.MAX_ENTRIES + 99}:"
                       f"{PeerHealth.MAX_ENTRIES + 99}")
@@ -700,7 +700,9 @@ def test_farm_inherits_request_deadline_and_stops_at_expiry(farm_node):
     # the dispatched cell's per-task deadline is the REQUEST deadline,
     # not now + TASK_DEADLINE_S (5 s)
     assert wait_for(lambda: node.active_tasks, timeout=3.0)
-    (_row, _col, task_deadline) = next(iter(node.active_tasks.values()))
+    (_row, _col, task_deadline, _t0) = next(
+        iter(node.active_tasks.values())
+    )
     assert task_deadline == pytest.approx(deadline_s, abs=0.05)
     assert task_deadline < t0 + TASK_DEADLINE_S - 1.0
     t.join(timeout=10)
@@ -723,7 +725,9 @@ def test_farm_without_deadline_keeps_fixed_task_deadline(farm_node):
     t0 = time.monotonic()
     t.start()
     assert wait_for(lambda: node.active_tasks, timeout=3.0)
-    (_row, _col, task_deadline) = next(iter(node.active_tasks.values()))
+    (_row, _col, task_deadline, _t0) = next(
+        iter(node.active_tasks.values())
+    )
     assert task_deadline == pytest.approx(t0 + TASK_DEADLINE_S, abs=0.5)
     # unblock the farm: every worker "departs", so the master answers
     # from its authoritative local engine
