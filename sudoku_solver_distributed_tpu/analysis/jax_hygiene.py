@@ -29,6 +29,19 @@ Rules:
   JAX104 (error)   ``jax.jit`` called inside a function that is neither
                    module setup (``__init__``) nor memoized with
                    ``functools.lru_cache``/``cache`` — a per-call trace.
+  JAX105 (error)   host reuse of a buffer passed at a ``donate_argnums``
+                   position after the donating call (PR 15 — the
+                   segment program donates its carried state): the
+                   donated array is DELETED the moment the call is
+                   enqueued, so any later read raises "Array has been
+                   deleted" at an arbitrary distance from the bug. The
+                   blessed pattern rebinds the name from the call's own
+                   results (``state, d, g = prog(state, ...)``); a later
+                   independent rebind also launders. Tracked for plain
+                   Name arguments of jit callables assigned with
+                   ``donate_argnums`` (locals and ``self.X`` attrs),
+                   lexically by line — the same approximation budget as
+                   the other rules.
 
 Device taint is tracked per function: calls to jit-made callables
 (``self.X = jax.jit(...)`` attributes, ``name = jax.jit(...)`` locals,
@@ -480,6 +493,130 @@ def _jit_in_function_findings(
     walk(mod.tree.body, None, False, None)
 
 
+def _donated_reuse_findings(
+    mod: Module, findings: List[Finding]
+):
+    """JAX105: host reuse of a donated buffer after the donating call.
+
+    Donating callables are assignments ``X = jax.jit(...,
+    donate_argnums=(..))`` (local, module-level, or ``self.X``). At each
+    call site ``X(a, b, ...)``, a plain-Name argument in a donated
+    position marks that name dead from the call's last line onward —
+    unless the SAME statement rebinds it from the call's results (the
+    blessed carried-state pattern). Any later Load of a dead name flags,
+    up to and including the right-hand side of a later independent
+    rebind (``state = other(state)`` still reads the deleted array);
+    Loads strictly after a rebind are laundered."""
+    donating: Dict[str, Set[int]] = {}
+    for stmt in ast.walk(mod.tree):
+        for _target, value in assign_targets(stmt) if isinstance(
+            stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)
+        ) else []:
+            call = _jit_call(mod, value)
+            if call is None:
+                continue
+            nums: Set[int] = set()
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    nums |= _int_elts(kw.value)
+            if not nums:
+                continue
+            tname = (
+                _target.id
+                if isinstance(_target, ast.Name)
+                else self_attr(_target)
+            )
+            if tname:
+                donating[tname] = donating.get(tname, set()) | nums
+    if not donating:
+        return
+
+    def scan(fn: ast.FunctionDef, symbol: str) -> None:
+        donations: List[Tuple[str, int, str]] = []  # name, end line, fn
+        assigns: List[Tuple[str, int]] = []
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, ast.stmt):
+                continue
+            rebound: Set[str] = set()
+            for target, _value in assign_targets(stmt):
+                if isinstance(target, ast.Name):
+                    rebound.add(target.id)
+                    assigns.append((target.id, stmt.lineno))
+            # scan only THIS statement's own expressions — a compound
+            # statement (if/try/for/with) must not re-visit its children
+            # with an empty rebound set (they are statements of their
+            # own and get their own visit)
+            exprs: List[ast.expr] = []
+            for _field, value in ast.iter_fields(stmt):
+                if isinstance(value, ast.expr):
+                    exprs.append(value)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            exprs.append(v)
+                        elif isinstance(v, ast.withitem):
+                            exprs.append(v.context_expr)
+            for sub in (
+                node for e in exprs for node in ast.walk(e)
+            ):
+                if not isinstance(sub, ast.Call):
+                    continue
+                func = sub.func
+                fname = (
+                    func.id
+                    if isinstance(func, ast.Name)
+                    else self_attr(func)
+                )
+                if fname not in donating:
+                    continue
+                dend = getattr(sub, "end_lineno", None) or sub.lineno
+                for i, arg in enumerate(sub.args):
+                    if (
+                        i in donating[fname]
+                        and isinstance(arg, ast.Name)
+                        and arg.id not in rebound
+                    ):
+                        donations.append((arg.id, dend, fname))
+        for name, dend, fname in donations:
+            rebinds_after = [
+                line for n, line in assigns if n == name and line > dend
+            ]
+            clear_at = min(rebinds_after) if rebinds_after else None
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id == name
+                    and node.lineno > dend
+                    and (clear_at is None or node.lineno <= clear_at)
+                ):
+                    findings.append(
+                        Finding(
+                            "JAX105",
+                            "error",
+                            mod.rel_path,
+                            node.lineno,
+                            symbol,
+                            f"use of {name!r} after it was donated to "
+                            f"{fname!r} (donate_argnums) — the buffer "
+                            f"is deleted at dispatch; rebind the name "
+                            f"from the call's results or rebuild the "
+                            f"state",
+                        )
+                    )
+                    break  # one finding per donation is signal enough
+
+    seen: Set[int] = set()
+    for cls in mod.classes():
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                seen.add(id(node))
+                scan(node, f"{cls.name}.{node.name}")
+    for fn in mod.functions():
+        if id(fn) not in seen:
+            scan(fn, fn.name)
+
+
 def _symbol_for(mod: Module, node: ast.AST) -> str:
     """Qualname-ish symbol of the enclosing class.method/function."""
     target_line = getattr(node, "lineno", 0)
@@ -551,4 +688,5 @@ def analyze_module(mod: Module) -> List[Finding]:
     _traced_branch_findings(mod, index, findings)
     _static_arg_findings(mod, index, findings)
     _jit_in_function_findings(mod, findings)
+    _donated_reuse_findings(mod, findings)
     return findings

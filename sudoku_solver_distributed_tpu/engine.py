@@ -55,6 +55,37 @@ DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
 _AUTO = object()
 
 
+class _SegmentHandle:
+    """One in-flight continuous-batching segment (PR 15): everything
+    ``finalize_segment`` needs to fetch, account, and close the
+    supervision token, plus the carried-forward pool ``state`` — which
+    is available at DISPATCH time, so the driver can chain segment N+1
+    off it before segment N's digest is ever read (the one-deep pipeline
+    at the segment seam). On the pipelined arm ``digest``/``gathered``
+    are the split device outputs (two-phase fetch) and ``rows`` is None;
+    on the PR 12 arm ``rows`` is the full packed device array."""
+
+    __slots__ = (
+        "state", "digest", "gathered", "rows", "token", "t0", "width",
+        "injected", "pipelined", "boundary_host_s",
+    )
+
+    def __init__(
+        self, *, state, digest, gathered, rows, token, t0, width,
+        injected, pipelined, boundary_host_s,
+    ):
+        self.state = state
+        self.digest = digest
+        self.gathered = gathered
+        self.rows = rows
+        self.token = token
+        self.t0 = t0
+        self.width = width
+        self.injected = injected
+        self.pipelined = pipelined
+        self.boundary_host_s = boundary_host_s
+
+
 class SolverEngine:
     """Batched sudoku solving behind static-shape compiled programs.
 
@@ -160,6 +191,21 @@ class SolverEngine:
         size). Smaller = finished lanes refill sooner (higher sustained
         lane utilization, lower deadline-conditioned tails), larger
         amortizes segment dispatch overhead.
+      segment_pipeline: the pipelined segment boundary (PR 15, continuous
+        path only — None resolves ops.config.SEGMENT_PIPELINE, ON). The
+        segment program DONATES its state buffers (the carried
+        (width, D, C) stack updates in place instead of copying every
+        segment) and returns a compact per-lane completion digest next to
+        the device-resident state; the host fetches the digest every
+        boundary and full solution rows only for newly-solved lanes
+        (two-phase fetch — ~80× fewer boundary bytes at 25×25), and the
+        coalescer's driver overlaps boundary host work with device
+        compute (parallel/coalescer.py). ``segment_pipeline=False`` (CLI
+        ``--no-segment-pipeline``) restores the PR 12 boundary
+        byte-for-byte — full-row fetch, no donation, strictly serial
+        boundaries — the A/B arm of ``bench.py --mode continuous``.
+        Answers are bit-identical either way (the digest/gather split
+        never touches board trajectories; tests/test_continuous.py).
       compile_cache_dir: root of the persistent compile plane
         (compilecache/): ``<dir>/xla`` hosts jax's persistent compilation
         cache (first-wins — an env/session-configured cache dir is never
@@ -216,6 +262,7 @@ class SolverEngine:
         coalesce_adaptive: bool = False,
         continuous: Optional[bool] = None,
         segment_iters: Optional[int] = None,
+        segment_pipeline: Optional[bool] = None,
         deep_lane_cap: int = 0,
         compile_cache_dir: Optional[str] = None,
         aot_artifacts: bool = True,
@@ -526,6 +573,24 @@ class SolverEngine:
                     "sharded segment program to ride otherwise"
                 )
         self.continuous = bool(continuous)
+        # Pipelined segment boundary (PR 15): donation + digest-only
+        # fetch + overlapped host refill on the continuous path. Resolved
+        # here so the program build below and _program_config() agree by
+        # construction; False restores the PR 12 boundary byte-for-byte.
+        from .ops.config import SEGMENT_PIPELINE
+
+        if segment_pipeline is None:
+            segment_pipeline = (
+                SEGMENT_PIPELINE["default_on"] and self.continuous
+            )
+        elif segment_pipeline and not self.continuous:
+            raise ValueError(
+                "segment_pipeline=True needs continuous batching — the "
+                "pipelined boundary is the continuous path's segment "
+                "seam (closed-loop dispatch already pipelines via "
+                "inflight_depth)"
+            )
+        self.segment_pipeline = bool(segment_pipeline)
         # long-job lane cap for the continuous driver (ISSUE 13
         # satellite, CLI --deep-lane-cap): bound the lanes deep-resident
         # boards may hold while fresh demand queues; overage evicts to
@@ -792,6 +857,51 @@ class SolverEngine:
                 solver_overrides=tuple(
                     sorted(self.solver_overrides.items())
                 ),
+                pipeline=self.segment_pipeline,
+            )
+        elif self.segment_pipeline:
+            # Pipelined arm (PR 15): source-indexed injection, the
+            # carried SegmentState DONATED (the (width, D, C) stack — the
+            # state's bulk — updates in place instead of copying every
+            # segment; the input handle is dead after dispatch, guarded
+            # at the seam in dispatch_segment), and the outputs split
+            # into the compact per-lane digest fetched every boundary
+            # plus the prefix-gathered solution block fetched only when
+            # a lane newly solved (ops/solver.segment_digest). boards/
+            # src are NOT donated — the driver reuses its cached idle
+            # argument pair across segments.
+            def _run_segment_prog_pipelined(state, boards, src, seg_iters):
+                from .ops.config import segment_prefix_gather
+                from .ops.solver import (
+                    inject_lanes_src,
+                    run_segment,
+                    segment_digest,
+                )
+
+                B = boards.shape[0]
+                waves_eff = 1 if B == 1 else self.waves
+                _packed, _legacy = self._loop_flavor()
+                state = inject_lanes_src(state, boards, src, self.spec)
+                entry_running = state.status == RUNNING
+                state, lstats = run_segment(
+                    state, seg_iters, self.spec,
+                    locked_candidates=self.locked_candidates,
+                    waves=waves_eff, naked_pairs=self.naked_pairs,
+                    packed=_packed, legacy_merges=_legacy,
+                )
+                digest, gathered = segment_digest(
+                    state, entry_running, lstats,
+                    # trace-time form choice from the pool's STATIC
+                    # byte size — the ONE shared predicate, so the
+                    # host-side fetch agrees by construction
+                    prefix_gather=segment_prefix_gather(
+                        B, self.spec.cells
+                    ),
+                )
+                return state, digest, gathered
+
+            self._segment_program = jax.jit(
+                _run_segment_prog_pipelined, donate_argnums=(0,)
             )
         else:
             def _run_segment_prog(state, boards, inject, seg_iters):
@@ -936,6 +1046,10 @@ class SolverEngine:
                 "enabled": self.continuous_active,
                 "configured": self.continuous,
                 "segment_iters": self.segment_iters,
+                # the pipelined boundary arm (PR 15): digest-only fetch
+                # + donation + overlapped refill vs the PR 12 full-row
+                # boundary (--no-segment-pipeline)
+                "pipeline": self.segment_pipeline,
             },
             "warmed": self.warmed,
             "fully_warmed": self.fully_warmed,
@@ -1356,6 +1470,280 @@ class SolverEngine:
             jnp.asarray(boards), self.spec, self._depth_flat
         )
 
+    def dispatch_segment(
+        self,
+        state,
+        boards: np.ndarray,
+        inject: Optional[np.ndarray] = None,
+        *,
+        src: Optional[np.ndarray] = None,
+        seg_iters: Optional[int] = None,
+        injected: Optional[int] = None,
+        pipelined: bool = False,
+        boundary_host_s: float = 0.0,
+    ) -> "_SegmentHandle":
+        """Async half of one continuous-batching segment: open the
+        supervision token, run the engine-seam fault injector's dispatch
+        hook, enqueue the compiled segment program, and return an
+        in-flight handle for :meth:`finalize_segment` — the segment-seam
+        twin of ``_dispatch_padded``.
+
+        Injection payload: the PR 12 arm takes the row-aligned ``inject``
+        mask; the pipelined arm takes ``src`` (the per-lane source map of
+        ``ops.solver.inject_lanes_src`` — ``-1`` no-op, ``-2`` pad
+        re-seed, else a ``boards`` row), and converts a mask to the
+        identity map when only ``inject`` is given so library/test
+        callers work on both arms.
+
+        DONATION SEAM GUARD (pipelined arm): the passed ``state`` is
+        consumed by this call — its buffers are donated to the program
+        and the handle's ``state`` is the only live pool afterwards. A
+        caller that passes an already-donated state (any error path must
+        REBUILD the pool, never retry with a dead handle) gets a
+        RuntimeError here instead of a deep XLA "Array has been deleted"
+        from an arbitrary later op.
+
+        ``pipelined=True`` marks a speculative dispatch issued while the
+        previous segment's digest is still unfetched: its supervision
+        token is sized at 2× the watchdog budget because its dispatch→
+        fetch span legitimately includes the whole segment ahead of it
+        in the device queue (serving/health.py ``budget_scale``).
+
+        ``boundary_host_s`` is the host-side gap since the previous
+        segment's digest fetch completed — the device-idle window the
+        pipelined driver exists to close, stamped into obs/cost.py at
+        finalize (0 for speculative dispatches: they overlap by
+        construction).
+        """
+        width = boards.shape[0]
+        if self.segment_pipeline and state is not None:
+            g = getattr(state, "grid", None)
+            deleted = getattr(g, "is_deleted", None)
+            if deleted is not None and deleted():
+                raise RuntimeError(
+                    "segment pool state was already donated to an "
+                    "earlier dispatch — a failed or superseded segment "
+                    "must rebuild the pool (new_segment_pool), never "
+                    "reuse a donated handle"
+                )
+        sup = self.supervisor
+        token = (
+            sup.call_started(width, budget_scale=2.0 if pipelined else 1.0)
+            if sup is not None
+            else None
+        )
+        t0 = time.monotonic()
+        try:
+            inj = self.fault_injector
+            if inj is not None:
+                inj.on_device_call(width)  # may raise (fail-next-N)
+            self._note_program("segment", width)
+            # callers may pass device-resident boards/src (the driver
+            # caches the idle no-injection pair and the prestager places
+            # the refill stack while the previous segment runs):
+            # converting 2 KB of numpy per segment costs more than the
+            # whole digest fetch at CPU serving widths, so skip it when
+            # already placed
+            if not isinstance(boards, jax.Array):
+                boards = self._device_batch(boards)
+            it = self._iter_scalar(
+                int(seg_iters) if seg_iters else self.segment_iters
+            )
+            if self.segment_pipeline:
+                if src is None:
+                    # mask → identity source map (row i injects lane i):
+                    # the library/test compatibility shim
+                    mask = np.asarray(inject).astype(bool)
+                    src = np.where(
+                        mask, np.arange(width, dtype=np.int32),
+                        np.int32(-1),
+                    )
+                if isinstance(src, jax.Array):
+                    src_dev = src
+                    if injected is None:
+                        injected = int(
+                            (
+                                np.asarray(jax.block_until_ready(src_dev))
+                                >= 0
+                            ).sum()
+                        )
+                else:
+                    src_np = np.asarray(src, np.int32)
+                    if injected is None:
+                        # real requests only: -2 pad re-seeds of
+                        # abandoned lanes are not injections
+                        injected = int((src_np >= 0).sum())
+                    src_dev = jnp.asarray(src_np, jnp.int32)
+                state, digest, gathered = self._segment_program(
+                    state, boards, src_dev, it
+                )
+                rows_dev = None
+                evidence = digest
+            else:
+                if inject is None:
+                    raise ValueError(
+                        "the PR 12 segment arm takes an inject mask — "
+                        "src= needs segment_pipeline=True"
+                    )
+                if isinstance(inject, jax.Array):
+                    inject_dev = inject
+                    if injected is None:
+                        # count injections from a settled host copy — an
+                        # eight-int fetch of a mask host-built moments ago
+                        injected = int(
+                            np.asarray(jax.block_until_ready(inject_dev))
+                            .astype(bool).sum()
+                        )
+                else:
+                    inject_np = np.asarray(inject)
+                    if injected is None:
+                        injected = int(inject_np.astype(bool).sum())
+                    inject_dev = jnp.asarray(inject_np, jnp.int32)
+                state, rows_dev = self._segment_program(
+                    state, boards, inject_dev, it
+                )
+                digest = gathered = None
+                evidence = rows_dev
+            if self.mesh is not None:
+                from .parallel.shard import split_evidence
+
+                split = split_evidence(evidence)
+                with self._lock:
+                    self.mesh_dispatches += 1
+                    self._mesh_last_split = split
+                    ndev = split.get("devices", 1)
+                    if (
+                        self._mesh_min_devices is None
+                        or ndev < self._mesh_min_devices
+                    ):
+                        self._mesh_min_devices = ndev
+        except BaseException:
+            if sup is not None:
+                sup.call_finished(token, ok=False)
+            raise
+        return _SegmentHandle(
+            state=state,
+            digest=digest,
+            gathered=gathered,
+            rows=rows_dev,
+            token=token,
+            t0=t0,
+            width=width,
+            injected=int(injected),
+            pipelined=bool(pipelined),
+            boundary_host_s=float(boundary_host_s),
+        )
+
+    def finalize_segment(self, handle: "_SegmentHandle", *, active):
+        """Blocking half: fetch the boundary bytes, close the
+        supervision token, and stamp the segment into obs/cost.py.
+
+        Pipelined arm — the TWO-PHASE fetch: phase 1 moves only the
+        (width, SEGMENT_DIGEST_COLS) int32 digest; when any lane's
+        ``fetch_slot`` is set (it newly solved this segment), phase 2
+        fetches the prefix of the on-device gathered solution block
+        covering exactly those lanes. The returned ``rows`` keep the
+        PR 12 (width, C+7) packed layout — grid columns are zero for
+        lanes whose solution was never fetched (never needed: the driver
+        reads grids only for newly-solved lanes) — so every downstream
+        reader (``_row_result``, ``_account_coalesced``, the deep-retry
+        counter merge) is arm-agnostic.
+
+        ``active`` is the (width,) bool mask of lanes holding a live
+        request at FETCH time — for a speculative dispatch the driver's
+        slot table may have resolved lanes since dispatch, and the
+        fill/utilization denominators should reflect that.
+
+        Returns ``(rows, device_s)``; the carried pool state is on the
+        handle (it was available at dispatch — that is the point).
+        """
+        sup = self.supervisor
+        width = handle.width
+        fetch_bytes = 0
+        C = self.spec.cells
+        try:
+            inj = self.fault_injector
+            if inj is not None:
+                inj.on_fetch(width)  # may sleep (watchdog food)
+            if handle.rows is not None:
+                # PR 12 arm: the full packed rows, one transfer —
+                # byte-for-byte the --no-segment-pipeline boundary
+                rows = np.array(jax.block_until_ready(handle.rows))
+                fetch_bytes = rows.nbytes
+            else:
+                digest = np.array(jax.block_until_ready(handle.digest))
+                fetch_bytes = digest.nbytes
+                rows = np.zeros((width, C + 7), np.int32)
+                rows[:, C] = digest[:, 1]       # solved
+                rows[:, C + 1] = digest[:, 0]   # status
+                rows[:, C + 2] = digest[:, 2]   # guesses
+                rows[:, C + 3] = digest[:, 3]   # validations
+                rows[:, C + 4] = digest[:, 4]   # board_iters
+                rows[:, C + 5] = digest[:, 6]   # lane_steps
+                rows[:, C + 6] = digest[:, 7]   # idle_lane_steps
+                slots = digest[:, 5]
+                lanes = np.nonzero(slots >= 0)[0]
+                if lanes.size:
+                    # phase 2: fetch the solution block. Large pools
+                    # slice the contiguous newly-solved prefix (bytes
+                    # proportional to finished lanes); small pools copy
+                    # the whole materialized block — the eager slice op
+                    # costs ~100× the bytes it saves there. The SAME
+                    # predicate the program traced with, so the host
+                    # reads the block exactly as the device built it
+                    # (segment_digest prefix_gather rationale)
+                    from .ops.config import segment_prefix_gather
+
+                    n = int(slots[lanes].max()) + 1
+                    if segment_prefix_gather(width, C):
+                        grids = np.array(
+                            jax.block_until_ready(handle.gathered[:n])
+                        )
+                    else:
+                        grids = np.array(
+                            jax.block_until_ready(handle.gathered)
+                        )
+                    fetch_bytes += grids.nbytes
+                    rows[lanes, :C] = grids[slots[lanes]]
+            if inj is not None:
+                rows = inj.corrupt(width, rows)
+        except BaseException:
+            if sup is not None:
+                sup.call_finished(handle.token, ok=False)
+            raise
+        if sup is not None:
+            sup.call_finished(handle.token, ok=True)
+        device_s = time.monotonic() - handle.t0
+        act = np.asarray(active, bool)
+        self.cost.note_segment(
+            width=width,
+            active=int(act.sum()),
+            injected=handle.injected,
+            resolved=int(((rows[:, C + 1] != RUNNING) & act).sum()),
+            device_s=device_s,
+            lane_steps=int(rows[0, C + 5]) if rows.shape[1] > C + 5 else 0,
+            idle_lane_steps=(
+                int(rows[0, C + 6]) if rows.shape[1] > C + 6 else 0
+            ),
+            pipelined=handle.pipelined,
+            boundary_host_s=handle.boundary_host_s,
+            fetch_bytes=fetch_bytes,
+        )
+        return rows, device_s
+
+    def abandon_segment(self, handle: "_SegmentHandle") -> None:
+        """Discard a dispatched-but-never-fetched segment (the pipelined
+        driver throws its speculative dispatch away when the segment
+        ahead of it failed — the donated pool state is suspect either
+        way and gets rebuilt). Closes the supervision token WITHOUT
+        feeding the breaker in either direction: an unfetched segment
+        proves nothing about the device, and double-counting the
+        failure that caused the abandonment would double-step the
+        breaker toward LOST."""
+        sup = self.supervisor
+        if sup is not None:
+            sup.call_abandoned(handle.token)
+
     def run_segment_supervised(
         self,
         state,
@@ -1365,6 +1753,7 @@ class SolverEngine:
         active: np.ndarray,
         seg_iters: Optional[int] = None,
         injected: Optional[int] = None,
+        boundary_host_s: float = 0.0,
     ):
         """One continuous-batching segment through THE supervised seam:
         a watchdog token opens around the dispatch→fetch span (the PR 5
@@ -1372,6 +1761,12 @@ class SolverEngine:
         engine-seam fault injector plugs in at the same two points, and
         the segment's device wall / lane counters are stamped into
         obs/cost.py — one locked append per SEGMENT, never per request.
+
+        Synchronous composition of ``dispatch_segment`` +
+        ``finalize_segment`` (the pipelined driver runs the two phases
+        itself so segment N+1 can dispatch before segment N's digest is
+        read); works on BOTH boundary arms — the pipelined engine
+        converts the ``inject`` mask to an identity source map.
 
         ``active`` is the (width,) bool mask of lanes holding a live
         request AFTER this boundary's injections — the fill/utilization
@@ -1393,82 +1788,12 @@ class SolverEngine:
         rows, and the segment's dispatch→fetch wall time (the riders'
         per-segment device-stage stamp).
         """
-        width = boards.shape[0]
-        sup = self.supervisor
-        token = sup.call_started(width) if sup is not None else None
-        t0 = time.monotonic()
-        try:
-            inj = self.fault_injector
-            if inj is not None:
-                inj.on_device_call(width)  # may raise (fail-next-N)
-            self._note_program("segment", width)
-            # callers may pass device-resident boards/inject (the driver
-            # caches the idle no-injection pair): converting 2 KB of numpy
-            # per segment costs more than the whole segment fetch at CPU
-            # serving widths, so skip it when already placed
-            if not isinstance(boards, jax.Array):
-                boards = self._device_batch(boards)
-            if isinstance(inject, jax.Array):
-                inject_dev = inject
-                if injected is None:
-                    # count injections from a settled host copy — an
-                    # eight-int fetch of a mask host-built moments ago
-                    injected = int(
-                        np.asarray(jax.block_until_ready(inject_dev))
-                        .astype(bool).sum()
-                    )
-            else:
-                inject_np = np.asarray(inject)
-                if injected is None:
-                    injected = int(inject_np.astype(bool).sum())
-                inject_dev = jnp.asarray(inject_np, jnp.int32)
-            state, packed = self._segment_program(
-                state,
-                boards,
-                inject_dev,
-                self._iter_scalar(
-                    int(seg_iters) if seg_iters else self.segment_iters
-                ),
-            )
-            if self.mesh is not None:
-                from .parallel.shard import split_evidence
-
-                split = split_evidence(packed)
-                with self._lock:
-                    self.mesh_dispatches += 1
-                    self._mesh_last_split = split
-                    ndev = split.get("devices", 1)
-                    if (
-                        self._mesh_min_devices is None
-                        or ndev < self._mesh_min_devices
-                    ):
-                        self._mesh_min_devices = ndev
-            if inj is not None:
-                inj.on_fetch(width)  # may sleep (watchdog food)
-            rows = np.array(jax.block_until_ready(packed))
-            if inj is not None:
-                rows = inj.corrupt(width, rows)
-        except BaseException:
-            if sup is not None:
-                sup.call_finished(token, ok=False)
-            raise
-        if sup is not None:
-            sup.call_finished(token, ok=True)
-        device_s = time.monotonic() - t0
-        C = self.spec.cells
-        act = np.asarray(active, bool)
-        self.cost.note_segment(
-            width=width,
-            active=int(act.sum()),
-            injected=int(injected),
-            resolved=int(((rows[:, C + 1] != RUNNING) & act).sum()),
-            device_s=device_s,
-            lane_steps=int(rows[0, C + 5]) if rows.shape[1] > C + 5 else 0,
-            idle_lane_steps=(
-                int(rows[0, C + 6]) if rows.shape[1] > C + 6 else 0
-            ),
+        handle = self.dispatch_segment(
+            state, boards, inject, seg_iters=seg_iters, injected=injected,
+            boundary_host_s=boundary_host_s,
         )
-        return state, rows, device_s
+        rows, device_s = self.finalize_segment(handle, active=active)
+        return handle.state, rows, device_s
 
     def _iter_scalar(self, iters: int):
         """Memoized device scalar for a traced iteration budget (shared
@@ -1659,13 +1984,26 @@ class SolverEngine:
         N = self.spec.size
         state = self.new_segment_pool(w)
         self._note_program("segment", w)
-        _state, packed = self._segment_program(
-            state,
-            self._device_batch(np.zeros((w, N, N), np.int32)),
-            jnp.zeros((w,), jnp.int32),
-            self._iter_scalar(self.segment_iters),
-        )
-        jax.block_until_ready(packed)
+        if self.segment_pipeline:
+            # the pipelined program's injection payload is a source map
+            # (-1 = no injection), and the warm state is consumed by
+            # donation — rebind it (the JAX105 carried-state contract)
+            # and prove the trace through the digest output
+            state, digest, _gathered = self._segment_program(
+                state,
+                self._device_batch(np.zeros((w, N, N), np.int32)),
+                jnp.full((w,), -1, jnp.int32),
+                self._iter_scalar(self.segment_iters),
+            )
+            jax.block_until_ready(digest)
+        else:
+            _state, packed = self._segment_program(
+                state,
+                self._device_batch(np.zeros((w, N, N), np.int32)),
+                jnp.zeros((w,), jnp.int32),
+                self._iter_scalar(self.segment_iters),
+            )
+            jax.block_until_ready(packed)
 
     def _warm_bucket(self, b: int) -> None:
         """Compile (or AOT-load) the width-``b`` bucket program and record
@@ -1733,8 +2071,20 @@ class SolverEngine:
             # shapes — an A/B would silently serve the wrong arm's plane
             cfg["segment"] = {
                 "continuous": self.continuous,
+                # the donated-arm program shape (PR 15): a donated
+                # digest-program artifact must never load into a
+                # --no-segment-pipeline engine (different signature AND
+                # different aliasing contract) or vice versa; the
+                # prefix-gather threshold is part of the traced form
+                "pipeline": self.segment_pipeline,
                 **self.segment_shape,
             }
+            if self.segment_pipeline:
+                from .ops.config import SEGMENT_PIPELINE
+
+                cfg["segment"]["prefix_gather_min_bytes"] = (
+                    SEGMENT_PIPELINE["prefix_gather_min_bytes"]
+                )
         if self.mesh is not None:
             # the mesh SHAPE and sharding spec are trace constants of the
             # shard_map program: a 4-way split is a different program than

@@ -38,6 +38,11 @@ Extensions (defaults preserve reference behavior):
                 pool, resolving finished lanes and injecting fresh boards
                 mid-flight; --no-continuous restores the closed-loop
                 dispatcher (A/B arm), --segment-iters sweeps k
+  --no-segment-pipeline
+                disable the pipelined segment boundary (PR 15, default
+                ON with continuous): digest-only boundary fetch, state
+                buffer donation, and overlapped host refill fall back
+                to the PR 12 full-row boundary byte-for-byte (A/B arm)
   --deep-lane-cap
                 with continuous batching: bound the lanes boards resident
                 longer than a few segments may occupy while demand
@@ -331,6 +336,16 @@ def build_parser() -> argparse.ArgumentParser:
         "refill (parallel/coalescer.py; the A/B escape hatch of "
         "bench.py --mode continuous). Answers are bit-identical either "
         "way",
+    )
+    parser.add_argument(
+        "--no-segment-pipeline",
+        action="store_true",
+        help="disable the pipelined segment boundary (PR 15): the "
+        "continuous driver falls back to the PR 12 boundary "
+        "byte-for-byte — full packed-row fetch every segment, no "
+        "buffer donation, strictly serial boundaries (the A/B escape "
+        "hatch of bench.py --mode continuous). Answers are "
+        "bit-identical either way",
     )
     parser.add_argument(
         "--deep-lane-cap",
@@ -629,6 +644,10 @@ def main(argv=None) -> None:
         # is the closed-loop A/B escape hatch
         "continuous": False if args.no_continuous else None,
         "segment_iters": args.segment_iters,
+        # pipelined segment boundary (PR 15): default ON with continuous
+        # (None resolves ops.config.SEGMENT_PIPELINE); the flag restores
+        # the PR 12 boundary byte-for-byte
+        "segment_pipeline": False if args.no_segment_pipeline else None,
         "deep_lane_cap": args.deep_lane_cap,
         "compile_cache_dir": args.compile_cache_dir,
         "solver_config": args.solver_config,

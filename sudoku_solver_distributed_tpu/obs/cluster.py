@@ -125,6 +125,18 @@ def build_digest(node, prev: Optional[tuple] = None) -> tuple:
     if slo is not None:
         digest["slo_fast_burn"] = bool(slo.fast_burn_active())
 
+    autopilot = getattr(node, "autopilot", None)
+    if autopilot is not None and hasattr(autopilot, "farm_rtt_p99_ms"):
+        # the node's MEASURED farm-task RTT p99 (PR 15): published only
+        # once enough local folds exist (never the cold default — a
+        # fleet of idle masters must not anchor each other to it), so a
+        # cold master can seed its hedge threshold from the fleet's
+        # real tail instead of guessing 1 s (serving/autopilot.py
+        # hedge_threshold_s)
+        farm_p99 = autopilot.farm_rtt_p99_ms()
+        if farm_p99 is not None:
+            digest["farm_rtt_p99_ms"] = farm_p99
+
     cache = getattr(node, "answer_cache", None)
     if cache is not None:
         # the answer cache's scalars (ISSUE 13): absolute hit/miss

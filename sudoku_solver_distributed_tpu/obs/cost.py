@@ -98,6 +98,15 @@ class CostAccounting:
             "device_s": 0.0,
             "lane_steps": 0,
             "idle_lane_steps": 0,
+            # pipelined-boundary evidence (PR 15): speculative dispatches
+            # issued before the previous digest was read, the host-side
+            # boundary gap the pipeline exists to close, and the bytes
+            # actually moved per boundary (digest + phase-2 solution
+            # prefix on the pipelined arm, full packed rows on the PR 12
+            # arm — the fetch-cut proof reads straight off this)
+            "pipelined": 0,
+            "boundary_host_s": 0.0,
+            "fetch_bytes": 0,
         }
         # farm-route counters (ISSUE 14): the master's merge fold feeds
         # these — cell dispatches and hedge duplicates are dispatch-plane
@@ -170,6 +179,9 @@ class CostAccounting:
         device_s: float,
         lane_steps: int = 0,
         idle_lane_steps: int = 0,
+        pipelined: bool = False,
+        boundary_host_s: float = 0.0,
+        fetch_bytes: int = 0,
     ) -> None:
         """One continuous-batching segment finalized (ISSUE 12,
         engine.run_segment_supervised): lane-pool width, lanes carrying a
@@ -204,10 +216,15 @@ class CostAccounting:
             t["device_s"] += device_s
             t["lane_steps"] += lane_steps
             t["idle_lane_steps"] += idle_lane_steps
+            t["pipelined"] += int(bool(pipelined))
+            t["boundary_host_s"] += max(0.0, boundary_host_s)
+            t["fetch_bytes"] += max(0, int(fetch_bytes))
             self._segments.append(
                 (
                     time.monotonic(), device_s, active, width, injected,
                     resolved, lane_steps, idle_lane_steps,
+                    int(bool(pipelined)), max(0.0, boundary_host_s),
+                    max(0, int(fetch_bytes)),
                 )
             )
 
@@ -290,6 +307,9 @@ class CostAccounting:
             rec_resolved = sum(s[5] for s in rec)
             rec_occ = sum(s[2] for s in rec)
             rec_slots = sum(s[3] for s in rec)
+            rec_piped = sum(s[8] for s in rec)
+            rec_boundary = sum(s[9] for s in rec)
+            rec_fetch = sum(s[10] for s in rec)
             out["continuous"] = {
                 "segments": seg_totals["segments"],
                 "injected": seg_totals["injected"],
@@ -307,6 +327,29 @@ class CostAccounting:
                 ),
                 "sustained_occupancy_pct": _pct(rec_occ, rec_slots),
                 "recent_segments": len(rec),
+                # pipelined-boundary gauges (PR 15): lifetime totals plus
+                # the sustained recent-window view — is the boundary
+                # actually overlapped RIGHT NOW, and what does a boundary
+                # cost in host ms and fetched bytes. ``pipeline_depth``
+                # is the mean in-flight segment depth (1 = strictly
+                # serial boundaries, 2 = every segment had its successor
+                # dispatched before its digest was read).
+                "pipelined": seg_totals["pipelined"],
+                "fetch_bytes": seg_totals["fetch_bytes"],
+                "boundary_host_ms": round(
+                    1e3 * seg_totals["boundary_host_s"]
+                    / seg_totals["segments"],
+                    3,
+                ),
+                "sustained_boundary_host_ms": (
+                    round(1e3 * rec_boundary / len(rec), 3) if rec else 0.0
+                ),
+                "sustained_fetch_bytes_per_segment": (
+                    round(rec_fetch / len(rec), 1) if rec else 0.0
+                ),
+                "sustained_pipeline_depth": (
+                    round(1.0 + rec_piped / len(rec), 3) if rec else 0.0
+                ),
             }
         if any(farm.values()):
             # the farm dispatch plane (ISSUE 14): present only once the

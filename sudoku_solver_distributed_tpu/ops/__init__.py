@@ -18,11 +18,14 @@ from .validate import (
 )
 from .propagate import propagate, propagate_step
 from .solver import (
+    SEGMENT_DIGEST_COLS,
     SegmentState,
     SolveResult,
     init_segment_state,
     inject_lanes,
+    inject_lanes_src,
     run_segment,
+    segment_digest,
     solve_batch,
 )
 from .config import (
@@ -53,10 +56,13 @@ __all__ = [
     "propagate_step",
     "solve_batch",
     "SolveResult",
+    "SEGMENT_DIGEST_COLS",
     "SegmentState",
     "init_segment_state",
     "inject_lanes",
+    "inject_lanes_src",
     "run_segment",
+    "segment_digest",
     "SERVING_CONFIG",
     "serving_config",
     "cpu_serving_config",

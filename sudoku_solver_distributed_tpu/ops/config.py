@@ -228,6 +228,38 @@ _SEGMENT_DEFAULT = dict(k=16)
 # hatch that restores the closed-loop run-to-completion dispatcher.
 CONTINUOUS_SERVING = dict(default_on=True)
 
+# The pipelined segment boundary (PR 15): with continuous batching, the
+# segment program donates its state buffers (in-place carried state, no
+# per-segment HBM copy of the stack), the host fetches only a compact
+# per-lane digest at each boundary (two-phase fetch — solution rows are
+# prefix-gathered on-device and fetched only for newly-solved lanes,
+# ops/solver.segment_digest), and the driver overlaps boundary host work
+# with device compute (dispatch-before-resolve + one-deep speculative
+# dispatch + injection pre-staging, parallel/coalescer.py).
+# ``--no-segment-pipeline`` / SolverEngine(segment_pipeline=False)
+# restores the PR 12 boundary byte-for-byte — the A/B arm of
+# ``bench.py --mode continuous``.
+#
+# ``prefix_gather_min_bytes``: below this pool-block size the digest
+# program skips the prefix-gather permutation and the host fetches the
+# (masked) solution block whole — at serving widths an eager slice op
+# costs ~100× the bytes it saves (0.74 ms vs 4 µs measured on CPU at
+# 8×81 int32; ops/solver.segment_digest rationale), while at large
+# pools / 25×25 the contiguous prefix slice is what keeps the phase-2
+# fetch proportional to finished lanes instead of pool size.
+SEGMENT_PIPELINE = dict(default_on=True, prefix_gather_min_bytes=1 << 16)
+
+
+def segment_prefix_gather(width: int, cells: int) -> bool:
+    """THE prefix-gather form decision for a (width, cells) pool — one
+    predicate shared by the single-device program trace (engine.py),
+    the mesh twin (parallel/shard.py), and the host-side phase-2 fetch
+    (engine.finalize_segment). The host must interpret the gathered
+    block exactly as the trace built it; three hand-copies of this
+    formula would eventually disagree and silently assign the wrong
+    lanes' grids."""
+    return width * cells * 4 >= SEGMENT_PIPELINE["prefix_gather_min_bytes"]
+
 
 def segment_config(size: int) -> dict:
     """Measured-default segment shape for an N×N board."""

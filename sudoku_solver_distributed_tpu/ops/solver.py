@@ -734,6 +734,144 @@ def inject_lanes(
     return SegmentState(*(merge(f, s) for f, s in zip(fresh, state)))
 
 
+def align_src_boards(
+    boards: jnp.ndarray, src: jnp.ndarray, spec: BoardSpec
+) -> tuple:
+    """Resolve a per-lane source map into lane-aligned injection
+    payload: returns ``(aligned, inject)`` where ``aligned`` is the
+    (B, N, N) board each lane would re-initialize from and ``inject``
+    the (B,) int32 mask of lanes that actually do. THE one home of the
+    source-map sentinel semantics (``src[i] >= 0`` boards row, ``-1``
+    no-op, ``-2`` the instantly-UNSAT pad board as a trace constant) —
+    shared by :func:`inject_lanes_src` and the mesh twin's global
+    wrapper (parallel/shard.py), so the two arms' injection can never
+    drift."""
+    aligned = boards[jnp.clip(src, 0)]
+    aligned = jnp.where(
+        (src == -2)[:, None, None], pad_board(spec), aligned
+    )
+    return aligned, (src != -1).astype(jnp.int32)
+
+
+def inject_lanes_src(
+    state: SegmentState,
+    boards: jnp.ndarray,
+    src: jnp.ndarray,
+    spec: BoardSpec,
+) -> SegmentState:
+    """Source-indexed lane injection (PR 15 — the pipelined boundary's
+    form of :func:`inject_lanes`): ``src`` is a per-lane (B,) int32 map
+    into the ``boards`` stack instead of a row-aligned mask —
+
+      * ``src[i] >= 0``  — lane ``i`` re-initializes from ``boards[src[i]]``
+      * ``src[i] == -1`` — lane ``i`` passes through untouched
+      * ``src[i] == -2`` — lane ``i`` re-seeds from the instantly-UNSAT
+        pad board (a trace constant: abandoned deep-retry lanes need no
+        host-built pad row in the stack)
+
+    Decoupling board VALUES from lane POSITIONS is what lets the serving
+    driver pre-stage the ``boards`` stack to device (``jax.device_put``
+    off the driver thread) while the previous segment is still running:
+    which queued board lands in which freed lane is only known at the
+    boundary, but the tiny ``src`` vector is cheap to place then. Board
+    trajectories are identical to the masked form by construction — the
+    merged per-lane board values are the same.
+    """
+    aligned, inject = align_src_boards(boards, src, spec)
+    return inject_lanes(state, aligned, inject, spec)
+
+
+# Per-lane completion digest (PR 15 — digest-only boundary fetch): the
+# compact (B, SEGMENT_DIGEST_COLS) int32 block the host fetches at every
+# segment boundary INSTEAD of the full packed rows. Column layout:
+#
+#   0 status   1 solved   2 guesses   3 validations   4 board_iters
+#   5 fetch_slot — this lane's row in the prefix-gathered solution block
+#     when the lane NEWLY solved this segment (was RUNNING at segment
+#     entry, reads SOLVED now), else -1. The host fetches
+#     ``gathered[:max(fetch_slot)+1]`` only when any slot is set — the
+#     two-phase fetch: boundaries where nothing finished (the straggler-
+#     tail steady state) move SEGMENT_DIGEST_COLS ints per lane instead
+#     of C+7 (~80× fewer boundary bytes at 25×25).
+#   6 lane_steps / 7 idle_lane_steps — the segment's LoopStats scalars
+#     broadcast per row (same whole-call contract as the packed rows).
+SEGMENT_DIGEST_COLS = 8
+
+
+def segment_digest(
+    state: SegmentState,
+    entry_running: jnp.ndarray,
+    stats: LoopStats,
+    prefix_gather: bool = True,
+) -> tuple:
+    """Build the per-lane completion digest plus the gathered solution
+    block for a finished segment.
+
+    ``entry_running`` is the (B,) bool RUNNING mask at segment ENTRY
+    (after injection): a lane's solution is fetched exactly once — at
+    the boundary right after the segment in which it turned SOLVED — so
+    stale solved lanes from earlier boundaries never re-inflate the
+    phase-2 fetch.
+
+    ``prefix_gather`` picks the gathered block's form, a TRACE-TIME
+    choice made from the pool's byte size — always through
+    ``ops.config.segment_prefix_gather`` so the host-side fetch reads
+    the block exactly as the trace built it:
+
+      * True — newly-solved lanes are stably sorted to the block's
+        prefix (lane order) and ``fetch_slot`` is each lane's prefix
+        row: the host fetches ``gathered[:max(fetch_slot)+1]``, a
+        contiguous slice covering exactly the finished lanes. Right
+        when the block is big enough that moving it whole costs real
+        bytes (large pools / 25×25).
+      * False — the block is the grid stack itself with non-newly-
+        solved rows masked to zero and ``fetch_slot`` = the lane index:
+        no permutation machinery in the graph, and the host fetches the
+        whole (small) block in one copy — at serving widths an eager
+        slice op costs ~100× the bytes it saves (measured 2026-08-04,
+        CPU: 0.74 ms sliced vs 4 µs whole at 8×81 int32). The mask is
+        not cosmetic: it forces a buffer DISTINCT from the carried
+        state's grid, so donating the state to segment N+1 can never
+        invalidate (or let N+1 overwrite) a block the host has yet to
+        fetch.
+
+    Returns ``(digest, gathered)``: the (B, SEGMENT_DIGEST_COLS) int32
+    digest and the (B, C) int32 block. Both are program OUTPUTS
+    distinct from the carried state, which is what makes donating the
+    state input safe while a later segment is already consuming it.
+    """
+    B = state.grid.shape[0]
+    newly_solved = (state.status == SOLVED) & entry_running
+    if prefix_gather:
+        # stable bool sort: newly-solved lanes (key False) to the
+        # front, in lane order — the compaction ladder's prefix move
+        order = jnp.argsort(~newly_solved, stable=True)
+        gathered = state.grid[order]
+        pos = jnp.argsort(order)  # inverse perm: lane → prefix row
+        fetch_slot = jnp.where(newly_solved, pos, -1).astype(jnp.int32)
+    else:
+        gathered = jnp.where(newly_solved[:, None], state.grid, 0)
+        fetch_slot = jnp.where(
+            newly_solved,
+            jnp.arange(B, dtype=jnp.int32),
+            jnp.int32(-1),
+        )
+    digest = jnp.stack(
+        [
+            state.status,
+            (state.status == SOLVED).astype(jnp.int32),
+            state.guesses,
+            state.validations,
+            state.board_iters,
+            fetch_slot,
+            jnp.broadcast_to(stats.lane_steps, (B,)),
+            jnp.broadcast_to(stats.idle_lane_steps, (B,)),
+        ],
+        axis=1,
+    )
+    return digest, gathered
+
+
 def run_segment(
     state: SegmentState,
     seg_iters: jnp.ndarray,

@@ -62,6 +62,7 @@ queue depth, and request wait time. Surfaced at ``/metrics`` under
 
 from __future__ import annotations
 
+import heapq
 import logging
 import threading
 import time
@@ -90,6 +91,18 @@ NO_DEADLINE_HORIZON_S = 60.0
 # ~one configured segment (ops/config.SEGMENT picked k so they do), so
 # anything alive past a few boundaries is in real search depth.
 DEEP_RESIDENT_SEGMENTS = 4
+
+
+def _edf_key(r: "_Request") -> float:
+    """Earliest-deadline-first boarding key, with the liveness floor for
+    deadline-less requests (see _take_for_slots_locked). ONE definition
+    shared by the boundary's slot assignment and the injection
+    prestager, so a staged stack always covers the boundary's take."""
+    return (
+        r.deadline
+        if r.deadline is not None
+        else r.enqueued + NO_DEADLINE_HORIZON_S
+    )
 
 
 def _resolve(future: Future, result=None, exc=None) -> None:
@@ -126,6 +139,119 @@ class _Request:
         # the future, so the handler thread's finish-read is ordered by
         # the future itself. None (no tracing plane) costs one slot.
         self.trace = current_trace()
+
+
+class _InjectionPrestager:
+    """Pre-stages the next boundary's injection stack to device while
+    the current segment runs (PR 15, pipelined continuous arm only).
+
+    The segment program's source-indexed injection
+    (ops/solver.inject_lanes_src) decouples board VALUES from lane
+    POSITIONS: which queued board lands in which freed lane is only
+    known at the boundary, but the (width, N, N) board stack itself —
+    the boundary's dominant host cost, ~0.5 ms of ``jax.device_put`` at
+    CPU serving widths (engine.py measured) — can be placed as soon as
+    the requests are queued. A worker thread snapshots the pending
+    queue EDF-first (the same :func:`_edf_key` the boundary's slot
+    assignment sorts by, so the boundary's take is a subset of the
+    staged set whenever the queue didn't change), stacks the first
+    ``width`` boards, and places them; the driver claims the stage at
+    the boundary and falls back to the inline host build when any taken
+    request isn't covered (new earlier-deadline arrival, expiry). A
+    stale stage costs nothing but the wasted placement.
+    """
+
+    def __init__(self, coalescer: "BatchCoalescer", width: int):
+        self._co = coalescer
+        self._width = width
+        self._cond = threading.Condition()
+        self._wanted = False
+        self._shutdown = False
+        # (id(request) -> staged row, device boards stack, request refs —
+        # the refs pin id() stability for the map's lifetime)
+        self._staged: Optional[tuple] = None
+        self._thread = threading.Thread(
+            target=self._run, name="coalescer-prestage", daemon=True
+        )
+        self._thread.start()
+
+    def poke(self) -> None:
+        """Signal that a segment just dispatched: rebuild the stage for
+        the NEXT boundary from the post-take queue. Driver-paced — one
+        rebuild per segment, never per arrival (a per-arrival rebuild
+        measured as a whole core of device_put churn under overload,
+        starving the solver it was meant to feed)."""
+        with self._cond:
+            self._wanted = True
+            self._cond.notify()
+
+    def poke_if_unstaged(self) -> None:
+        """Arrival-path nudge: stage only when nothing is staged and no
+        rebuild is already queued — covers the empty-queue→first-arrival
+        case (the dispatch-paced poke above fired before any request
+        existed). The unlocked pre-check is a benign-race hint: at
+        thousands of arrivals per second the submit path must not take
+        the prestager lock every time; a missed nudge is repaired by the
+        next dispatch's poke."""
+        if self._staged is not None or self._wanted:
+            return
+        with self._cond:
+            if self._staged is None and not self._wanted:
+                self._wanted = True
+                self._cond.notify()
+
+    def claim(self) -> Optional[tuple]:
+        """Take the current stage (one-shot): ``(rowmap, boards_dev,
+        refs)`` or None when nothing usable is staged."""
+        with self._cond:
+            staged, self._staged = self._staged, None
+            return staged
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        eng = self._co._engine
+        N = eng.spec.size
+        while True:
+            with self._cond:
+                while not self._wanted and not self._shutdown:
+                    self._cond.wait()
+                if self._shutdown:
+                    return
+                self._wanted = False
+            with self._co._cond:
+                # bounded snapshot: EDF over a small FIFO prefix, not
+                # the whole queue — under overload the queue holds
+                # thousands and an O(Q) scan per segment on the driver's
+                # own core costs more than the device_put being staged.
+                # With deadline-uniform traffic EDF == FIFO and the
+                # prefix is exact; pathological deadline mixes just
+                # miss more often and fall back to the inline build.
+                bound = 4 * self._width
+                pending = [
+                    r
+                    for _, r in zip(range(bound), self._co._pending)
+                ]
+            if not pending:
+                continue
+            ordered = heapq.nsmallest(self._width, pending, key=_edf_key)
+            boards_np = np.zeros((self._width, N, N), np.int32)
+            rowmap = {}
+            for j, r in enumerate(ordered):
+                boards_np[j] = r.board
+                rowmap[id(r)] = j
+            try:
+                boards_dev = eng._device_batch(boards_np)
+            except Exception:  # noqa: BLE001 — staging is best-effort
+                logger.exception("injection prestage failed")
+                continue
+            with self._cond:
+                if not self._shutdown:
+                    self._staged = (rowmap, boards_dev, ordered)
 
 
 class BatchCoalescer:
@@ -248,6 +374,13 @@ class BatchCoalescer:
         self.refills = 0        # boards injected into freed lanes
         self._occupied = 0      # lanes holding a live request (gauge)
         self._retry_threads: list = []  # in-flight capped-lane deep retries
+        # pipelined-boundary driver state (PR 15): speculative dispatches
+        # issued before the previous digest was read, and injection
+        # prestage hit/miss accounting (_InjectionPrestager)
+        self.pipelined = 0
+        self.prestage_hits = 0
+        self.prestage_misses = 0
+        self._prestager: Optional[_InjectionPrestager] = None
         # long-job lane cap (ISSUE 13 satellite): see class docstring
         self.deep_lane_cap = max(0, int(deep_lane_cap))
         self.deep_evictions = 0  # residents evicted over the cap
@@ -270,8 +403,36 @@ class BatchCoalescer:
                 return
             self._started = True
             if self._continuous_active():
+                pipelined = bool(
+                    getattr(self._engine, "segment_pipeline", False)
+                )
+                # the prestager exists to OVERLAP the injection stack's
+                # device placement with device compute — on a host with
+                # a single CPU there is nothing to overlap with (its
+                # scan + device_put just timeshare the driver's core;
+                # measured as a net loss on the 1-CPU bench box), so it
+                # arms only where the host can actually run two things
+                # at once. SUDOKU_SEGMENT_PRESTAGE=1/0 overrides (tests
+                # force it on; a TPU host with a busy CPU can force it
+                # off)
+                import os as _os
+
+                env = _os.environ.get("SUDOKU_SEGMENT_PRESTAGE")
+                prestage = (
+                    env == "1"
+                    if env in ("0", "1")
+                    else (_os.cpu_count() or 1) > 1
+                )
+                if pipelined and prestage:
+                    self._prestager = _InjectionPrestager(
+                        self, self._engine.segment_pool_width()
+                    )
                 self._segment_thread = threading.Thread(
-                    target=self._segment_loop,
+                    target=(
+                        self._segment_loop_pipelined
+                        if pipelined
+                        else self._segment_loop
+                    ),
                     name="coalescer-segments",
                     daemon=True,
                 )
@@ -310,6 +471,8 @@ class BatchCoalescer:
             self._completer.join(timeout=timeout)
         if self._segment_thread is not None:
             self._segment_thread.join(timeout=timeout)
+        if self._prestager is not None:
+            self._prestager.close()
         for t in list(self._retry_threads):
             t.join(timeout=timeout)
 
@@ -350,6 +513,13 @@ class BatchCoalescer:
             self._last_arrival = req.enqueued
             depth = len(self._pending)
             self._cond.notify_all()
+        if self._prestager is not None:
+            # stage to device while the in-flight segment runs (PR 15):
+            # the boundary then injects from an already-placed stack
+            # instead of paying the device_put. Arrival-path staging
+            # only fills an EMPTY stage — rebuilds are paced by the
+            # driver's per-dispatch poke, never by the arrival rate
+            self._prestager.poke_if_unstaged()
         if depth > self.max_queue_depth:
             # benign race on a monotone high-water mark
             self.max_queue_depth = depth
@@ -397,6 +567,16 @@ class BatchCoalescer:
                 out["segments"] = self.segments
                 out["refills"] = self.refills
                 out["active_lanes"] = self._occupied
+                # the pipelined-boundary arm (PR 15): speculative
+                # dispatches and injection-prestage accounting — absent
+                # semantics preserved by always rendering (the flag
+                # tells the arms apart)
+                out["pipeline"] = bool(
+                    getattr(self._engine, "segment_pipeline", False)
+                )
+                out["pipelined_segments"] = self.pipelined
+                out["prestage_hits"] = self.prestage_hits
+                out["prestage_misses"] = self.prestage_misses
                 out["deep_lane_cap"] = self.deep_lane_cap
                 out["deep_evictions"] = self.deep_evictions
                 out["segment_width"] = (
@@ -661,16 +841,11 @@ class BatchCoalescer:
         # request boards as if its budget were NO_DEADLINE_HORIZON_S past
         # its arrival, so sustained deadline-carrying load can delay it at
         # most that long instead of starving it forever (a strict
-        # two-class sort re-queued it behind every fresh arrival)
-        ordered = sorted(
-            self._pending,
-            key=lambda r: (
-                r.deadline
-                if r.deadline is not None
-                else r.enqueued + NO_DEADLINE_HORIZON_S
-            ),
-        )
-        take = ordered[:free]
+        # two-class sort re-queued it behind every fresh arrival).
+        # nsmallest, not sorted: only ``free`` entries board and the
+        # queue holds thousands under overload — a full O(Q log Q) sort
+        # per boundary measured as real boundary-rate loss (PR 15)
+        take = heapq.nsmallest(free, self._pending, key=_edf_key)
         chosen = set(map(id, take))
         live = [r for r in self._pending if id(r) not in chosen]
         self._pending.clear()
@@ -740,8 +915,17 @@ class BatchCoalescer:
         # escalation never compiles a second program.
         boost = 0
         base_k = int(getattr(eng, "segment_iters", 1))
+        # previous segment's fetch-return time while the pool stayed
+        # busy — the boundary host gap the cost plane reports (PR 15
+        # A/B evidence); None across idle waits, so a quiet pool's
+        # waiting-for-work time never reads as boundary cost
+        last_done = None
         while True:
             with self._cond:
+                if not self._pending and not any(
+                    s is not None for s in slots
+                ):
+                    last_done = None  # pool idle: the gap is not a boundary
                 while (
                     not self._pending
                     and not any(s is not None for s in slots)
@@ -843,12 +1027,19 @@ class BatchCoalescer:
             if take:
                 boost = 0
             try:
+                t_call = time.monotonic()
                 with annotate(f"coalescer_segment_a{n_active}"):
                     state, rows, device_s = eng.run_segment_supervised(
                         state, boards, inject, active=active,
                         seg_iters=base_k << boost,
                         injected=len(take),
+                        boundary_host_s=(
+                            t_call - last_done
+                            if last_done is not None
+                            else 0.0
+                        ),
                     )
+                last_done = time.monotonic()
             except Exception as e:  # noqa: BLE001 — fail residents, not the loop
                 logger.exception("continuous segment failed")
                 with self._stats_lock:
@@ -863,6 +1054,9 @@ class BatchCoalescer:
                     _resolve(r.future, exc=e)
                 state = None  # pool state is suspect — rebuild on demand
                 stale.clear()  # a fresh pool has no abandoned lanes
+                # the failed span is device-fault wall, not boundary
+                # host cost — never let the next dispatch bill it
+                last_done = None
                 continue
             # -- per-segment span stamps, BEFORE any future resolves ------
             for r in slots:
@@ -951,6 +1145,408 @@ class BatchCoalescer:
                 eng._account_coalesced(np.stack(resolved_rows))
             # escalate on an empty boundary, snap back on any progress
             boost = 0 if (resolved_rows or take) else min(boost + 1, 4)
+
+    def _segment_loop_pipelined(self) -> None:
+        """The PR 15 open-loop driver: same contract as
+        :meth:`_segment_loop` (resolve finished lanes at every boundary,
+        drop expired entries, evict iteration-capped lanes, refill freed
+        slots), with the boundary itself pipelined three ways:
+
+          * **dispatch-before-resolve** — once segment N's digest is
+            fetched, segment N+1 is dispatched FIRST and the host-side
+            fan-out (future resolution, deep-retry spawns, accounting)
+            runs while N+1 executes on device;
+          * **one-deep speculation** — when the upcoming boundary
+            provably has nothing to inject (empty queue, no stale
+            lanes), segment N+1 is chained off the dispatched state
+            BEFORE segment N's digest is even read (JAX async dispatch:
+            the device runs back-to-back with zero host gap — the
+            closed loop's ``inflight_depth`` discipline at the segment
+            seam);
+          * **injection pre-staging** — the (width, N, N) refill stack
+            is placed on device by the prestager thread while the
+            previous segment runs; the boundary sends only the tiny
+            per-lane source map (ops/solver.inject_lanes_src).
+
+        Error contract: ANY dispatch/fetch failure fails the resident
+        lanes' futures and rebuilds the pool — the donated state of the
+        pipelined program is dead the moment a later segment consumed
+        it, so a failed boundary must never retry against an old
+        handle (engine.dispatch_segment guards the seam); a speculative
+        dispatch chained onto a failed segment is abandoned unfetched
+        (engine.abandon_segment — its token closes without feeding the
+        breaker).
+        """
+        eng = self._engine
+        width = eng.segment_pool_width()
+        N = eng.spec.size
+        C = eng.spec.cells
+        from ..ops.solver import RUNNING as _RUNNING
+
+        import jax.numpy as jnp
+
+        slots: list = [None] * width
+        ages = [0] * width
+        state = None
+        stale: set = set()
+        zeros = np.zeros((width, N, N), np.int32)
+        # the idle (no-injection) argument pair, device-resident and
+        # reused (same economics as the PR 12 loop — and the speculative
+        # dispatch ALWAYS uses it: speculation only happens when there
+        # is provably nothing to inject)
+        idle_boards = eng._device_batch(zeros)
+        idle_src = jnp.full((width,), -1, jnp.int32)
+        boost = 0
+        base_k = int(getattr(eng, "segment_iters", 1))
+        inflight = None          # engine _SegmentHandle, digest unread
+        last_fetch_done = None   # monotonic: previous finalize returned
+
+        def fail_pool(exc, t_anchor) -> None:
+            """Fail every resident's future and mark the pool for
+            rebuild (the donated state is suspect/dead either way)."""
+            nonlocal state, last_fetch_done
+            with self._stats_lock:
+                self.failed_batches += 1
+            # the failed span is device-fault wall, not boundary host
+            # cost — never let the next dispatch bill it
+            last_fetch_done = None
+            t_done = time.monotonic()
+            for i, r in enumerate(slots):
+                if r is None:
+                    continue
+                slots[i] = None
+                if r.trace is not None and not r.future.done():
+                    r.trace.mark("device", t_done - t_anchor)
+                _resolve(r.future, exc=exc)
+            stale.clear()
+            state = None
+
+        def build_and_dispatch(take, free_idx, t_inject):
+            """Seat ``take`` into the freed lanes, build the injection
+            payload (prestaged device stack when it covers the take,
+            inline host build otherwise), and dispatch one segment.
+            Returns the in-flight handle; mutates slots/ages/stale."""
+            nonlocal state, boost
+            if take or stale:
+                src_np = np.full((width,), -1, np.int32)
+                staged = (
+                    self._prestager.claim()
+                    if self._prestager is not None
+                    else None
+                )
+                use_staged = staged is not None and all(
+                    id(r) in staged[0] for r in take
+                )
+                for r, i in zip(take, free_idx):
+                    slots[i] = r
+                    ages[i] = 0
+                    stale.discard(i)
+                # abandoned deep-retry lanes the queue didn't refill
+                # re-seed from the pad board — a trace constant on this
+                # arm (src == -2), no host row needed
+                for i in stale:
+                    src_np[i] = -2
+                stale.clear()
+                if use_staged:
+                    rowmap, boards_dev, _refs = staged
+                    for r, i in zip(take, free_idx):
+                        src_np[i] = rowmap[id(r)]
+                    if take:
+                        with self._stats_lock:
+                            self.prestage_hits += 1
+                else:
+                    boards_np = zeros.copy()
+                    for j, (r, i) in enumerate(zip(take, free_idx)):
+                        boards_np[j] = r.board
+                        src_np[i] = j
+                    boards_dev = (
+                        eng._device_batch(boards_np)
+                        if take
+                        else idle_boards
+                    )
+                    if take and self._prestager is not None:
+                        # a miss is a stage that failed to cover the
+                        # take — meaningless when no prestager is armed
+                        with self._stats_lock:
+                            self.prestage_misses += 1
+                src_dev = jnp.asarray(src_np)
+            else:
+                boards_dev, src_dev = idle_boards, idle_src
+            active = np.array([s is not None for s in slots])
+            n_active = int(active.sum())
+            if state is None:
+                state = eng.new_segment_pool(width)
+            with self._stats_lock:
+                self.batches += 1
+                segment_id = self.batches
+                self.segments += 1
+                self.boards += len(take)
+                self.refills += len(take)
+                self.last_batch_fill = n_active
+                self._occupied = n_active
+                if n_active > self.max_batch_fill:
+                    self.max_batch_fill = n_active
+                for r in take:
+                    w = t_inject - r.enqueued
+                    self._wait_sum_s += w
+                    if w > self._wait_max_s:
+                        self._wait_max_s = w
+            cost = getattr(eng, "cost", None)
+            if cost is not None and take:
+                cost.note_formation(
+                    t_inject - min(r.enqueued for r in take), n_active
+                )
+            t_disp = time.monotonic()
+            for r in take:
+                if r.trace is not None:
+                    r.trace.mark("queue", t_inject - r.enqueued)
+                    r.trace.mark("coalesce", t_disp - t_inject)
+                    r.trace.bucket = width
+                    r.trace.batch_id = segment_id
+            if take:
+                boost = 0
+            with annotate(f"coalescer_segment_a{n_active}"):
+                # the boundary host gap measured at the dispatch call —
+                # payload build and (on a prestage miss) the device_put
+                # included: the span the pipeline exists to shrink
+                handle = eng.dispatch_segment(
+                    state,
+                    boards_dev,
+                    src=src_dev,
+                    seg_iters=base_k << boost,
+                    injected=len(take),
+                    boundary_host_s=(
+                        time.monotonic() - last_fetch_done
+                        if last_fetch_done is not None
+                        else 0.0
+                    ),
+                )
+            state = handle.state
+            if self._prestager is not None:
+                self._prestager.poke()
+            return handle
+
+        while True:
+            # -- ensure a segment is in flight (pool-idle intake) -------
+            if inflight is None:
+                with self._cond:
+                    if not self._pending and not any(
+                        s is not None for s in slots
+                    ):
+                        # pool idle: waiting-for-work time is not a
+                        # boundary gap (cost-plane honesty)
+                        last_fetch_done = None
+                    while (
+                        not self._pending
+                        and not any(s is not None for s in slots)
+                        and not self._shutdown
+                    ):
+                        self._cond.wait()
+                    if (
+                        self._shutdown
+                        and not self._pending
+                        and not any(s is not None for s in slots)
+                    ):
+                        break
+                    # pool-idle burst absorption — same rationale and
+                    # budgets as the PR 12 loop
+                    if not any(s is not None for s in slots):
+                        cap_at = (
+                            self._pending[0].enqueued if self._pending
+                            else time.monotonic()
+                        ) + self.max_wait_s
+                        while (
+                            len(self._pending) < width
+                            and not self._shutdown
+                        ):
+                            now = time.monotonic()
+                            quiet_at = (
+                                self._last_arrival + self.quiescence_s
+                            )
+                            if now >= cap_at or now >= quiet_at:
+                                break
+                            self._cond.wait(
+                                timeout=min(cap_at, quiet_at) - now
+                            )
+                    now = time.monotonic()
+                    dropped = self._drain_expired_locked(now)
+                    free_idx = [
+                        i for i, s in enumerate(slots) if s is None
+                    ]
+                    take = self._take_for_slots_locked(len(free_idx))
+                    self._cond.notify_all()
+                self._resolve_expired(dropped, now)
+                if not take and not any(s is not None for s in slots):
+                    continue  # everything drained had expired
+                try:
+                    inflight = build_and_dispatch(
+                        take, free_idx, time.monotonic()
+                    )
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("continuous segment dispatch failed")
+                    fail_pool(e, time.monotonic())
+                    continue
+            # -- one-deep speculation: nothing to inject → chain N+1
+            #    off the dispatched state before reading N's digest.
+            #    Quiescence-gated like the burst absorber: an empty
+            #    queue right after a resolution fan-out usually means
+            #    the woken cohort's next requests are mid-flight through
+            #    the handler threads — speculating then would make them
+            #    wait out a whole idle segment. Only a queue that is
+            #    empty AND quiet (no arrival within quiescence_s) is the
+            #    straggler-tail steady state speculation exists for.
+            spec_handle = None
+            spec_exc = None
+            if not stale and not self._shutdown:
+                with self._cond:
+                    queue_empty = not self._pending
+                    quiet = (
+                        time.monotonic() - self._last_arrival
+                        >= self.quiescence_s
+                    )
+                if queue_empty and quiet and any(
+                    s is not None for s in slots
+                ):
+                    try:
+                        spec_handle = eng.dispatch_segment(
+                            state,
+                            idle_boards,
+                            src=idle_src,
+                            seg_iters=base_k << boost,
+                            injected=0,
+                            pipelined=True,
+                        )
+                        state = spec_handle.state
+                        with self._stats_lock:
+                            self.batches += 1
+                            self.segments += 1
+                            self.pipelined += 1
+                    except Exception as e:  # noqa: BLE001
+                        spec_exc = e
+            # -- finalize segment N -------------------------------------
+            t_disp = inflight.t0
+            try:
+                active = np.array([s is not None for s in slots])
+                rows, device_s = eng.finalize_segment(
+                    inflight, active=active
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.exception("continuous segment failed")
+                if spec_handle is not None:
+                    eng.abandon_segment(spec_handle)
+                fail_pool(e, t_disp)
+                inflight = None
+                continue
+            last_fetch_done = time.monotonic()
+            # -- boundary N: classify lanes (no fan-out yet) ------------
+            for r in slots:
+                if (
+                    r is not None
+                    and r.trace is not None
+                    and not r.future.done()
+                ):
+                    r.trace.mark("device", device_s)
+                    r.trace.segments += 1
+            resolved_entries = []  # (request, row)
+            deep_entries = []      # (request, row copy) → deep retry
+            for i, r in enumerate(slots):
+                if r is None:
+                    continue
+                row = rows[i]
+                if int(row[C + 1]) != _RUNNING:
+                    slots[i] = None
+                    resolved_entries.append((r, row))
+                elif int(row[C + 4]) >= eng.max_iters:
+                    # iteration-capped lane: evict to the deep-retry
+                    # net; the lane re-seeds (``stale``) at the next
+                    # NON-speculative boundary — under pipelining that
+                    # can be one segment later than the PR 12 cadence,
+                    # a bounded extra segment of abandoned sweeps
+                    slots[i] = None
+                    stale.add(i)
+                    deep_entries.append((r, row.copy()))
+                else:
+                    ages[i] += 1
+            # -- long-job lane cap (ISSUE 13 satellite, same law as the
+            #    PR 12 loop — overage evicts longest-resident first,
+            #    bounded by unmet live demand)
+            if self.deep_lane_cap > 0:
+                now_d = time.monotonic()
+                with self._cond:
+                    demand = sum(
+                        1
+                        for r in self._pending
+                        if r.deadline is None or r.deadline >= now_d
+                    )
+                if demand > 0:
+                    deep = [
+                        i
+                        for i, r in enumerate(slots)
+                        if r is not None
+                        and ages[i] >= DEEP_RESIDENT_SEGMENTS
+                    ]
+                    free = sum(1 for s in slots if s is None)
+                    overage = min(
+                        len(deep) - self.deep_lane_cap,
+                        max(0, demand - free),
+                    )
+                    if overage > 0:
+                        deep.sort(key=lambda i: -ages[i])
+                        for i in deep[:overage]:
+                            r = slots[i]
+                            slots[i] = None
+                            stale.add(i)
+                            with self._stats_lock:
+                                self.deep_evictions += 1
+                            deep_entries.append((r, rows[i].copy()))
+            # -- drop expired queue entries at EVERY boundary -----------
+            now = time.monotonic()
+            with self._cond:
+                dropped = self._drain_expired_locked(now)
+            # -- dispatch segment N+1 BEFORE the host-side fan-out ------
+            next_handle = spec_handle
+            if next_handle is None and spec_exc is None:
+                with self._cond:
+                    free_idx = [
+                        i for i, s in enumerate(slots) if s is None
+                    ]
+                    take = self._take_for_slots_locked(len(free_idx))
+                    self._cond.notify_all()
+                if take or stale or any(s is not None for s in slots):
+                    try:
+                        next_handle = build_and_dispatch(
+                            take, free_idx, time.monotonic()
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        logger.exception(
+                            "continuous segment dispatch failed"
+                        )
+                        spec_exc = e
+            # -- host-side fan-out, overlapped with segment N+1 ---------
+            self._resolve_expired(dropped, now)
+            for r, row in resolved_entries:
+                _resolve(
+                    r.future,
+                    result=eng._row_result(row, routed="continuous"),
+                )
+            for r, row in deep_entries:
+                self._spawn_deep_retry(r, row)
+            if resolved_entries:
+                eng._account_coalesced(
+                    np.stack([row for _, row in resolved_entries])
+                )
+            injected_next = (
+                next_handle.injected if next_handle is not None else 0
+            )
+            boost = (
+                0
+                if (resolved_entries or injected_next)
+                else min(boost + 1, 4)
+            )
+            if spec_exc is not None:
+                fail_pool(spec_exc, last_fetch_done)
+                next_handle = None
+            inflight = next_handle
 
     def _spawn_deep_retry(self, req, row) -> None:
         """Deep-retry an iteration-capped evicted lane off the segment

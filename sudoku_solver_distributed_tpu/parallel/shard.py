@@ -277,21 +277,36 @@ def make_segment_serving_program(
     waves: int,
     naked_pairs,
     solver_overrides: tuple = (),
+    pipeline: bool = False,
 ):
     """The engine's continuous-batching segment program (PR 12),
     shard_mapped over ``data`` — the mesh twin of the single-device
     program ``engine._build_segment_program`` jits.
 
-    Returns a jitted ``fn(state, boards, inject, seg_iters) -> (state,
-    rows)`` where ``state`` is an ``ops.solver.SegmentState`` whose
-    per-lane arrays are sharded over the mesh, ``boards``/``inject`` are
-    the refill payload ((B, N, N) boards + a (B,) one-hot lane mask, B
-    the mesh-rounded pool width so every refill respects the
-    mesh-divisible rounding by construction), and ``rows`` is the
-    (B, C+7) packed host view ``[grid | solved | status | guesses |
-    validations | board_iters | lane_steps | idle_lane_steps]`` — the
-    trailing LoopStats columns psum-reduced over the mesh then broadcast
-    per row, the same whole-call contract as the bucket program above.
+    With ``pipeline=False`` (the PR 12 / --no-segment-pipeline arm):
+    a jitted ``fn(state, boards, inject, seg_iters) -> (state, rows)``
+    where ``state`` is an ``ops.solver.SegmentState`` whose per-lane
+    arrays are sharded over the mesh, ``boards``/``inject`` are the
+    refill payload ((B, N, N) boards + a (B,) one-hot lane mask, B the
+    mesh-rounded pool width so every refill respects the mesh-divisible
+    rounding by construction), and ``rows`` is the (B, C+7) packed host
+    view ``[grid | solved | status | guesses | validations |
+    board_iters | lane_steps | idle_lane_steps]`` — the trailing
+    LoopStats columns psum-reduced over the mesh then broadcast per
+    row, the same whole-call contract as the bucket program above.
+
+    With ``pipeline=True`` (PR 15): ``fn(state, boards, src, seg_iters)
+    -> (state, digest, gathered)`` — the donated-state digest program.
+    ``src`` is the per-lane source map of ``inject_lanes_src`` (board
+    values decoupled from lane positions so the driver can pre-stage
+    the stack); the board alignment gather and the digest/prefix-gather
+    run OUTSIDE the shard_map as global jit ops (GSPMD inserts the
+    collectives — newly-solved lanes from any shard land in one global
+    prefix the host can fetch as a contiguous slice), while the segment
+    loop itself stays shard-local. The digest's LoopStats columns are
+    psum-reduced over the mesh exactly like the packed rows' — the host
+    reads whole-call totals from row 0 either way. The ``state`` input
+    is donated: the carried pool updates in place per segment.
 
     Each shard's segment loop exits the moment its OWN lanes are all
     terminal (no cross-shard sync per iteration): per-board trajectories
@@ -299,7 +314,15 @@ def make_segment_serving_program(
     answer — it only stops billing idle lane sweeps, which is the point.
     """
     from ..ops.config import resolved_loop_shape
-    from ..ops.solver import SegmentState, inject_lanes, run_segment
+    from ..ops.solver import (
+        RUNNING,
+        LoopStats,
+        SegmentState,
+        align_src_boards,
+        inject_lanes,
+        run_segment,
+        segment_digest,
+    )
 
     data_spec = P("data")
     overrides = dict(solver_overrides)
@@ -309,6 +332,57 @@ def make_segment_serving_program(
     cells = spec.cells
     if isinstance(max_depth, (tuple, list)):
         max_depth = max(max_depth)
+
+    if pipeline:
+        def _run_shard_pipelined(state, boards, inject, seg_iters):
+            # boards arrive pre-aligned to lanes (the global gather ran
+            # in the wrapper below), so the shard body is row-local
+            state = inject_lanes(state, boards, inject, spec)
+            entry_running = state.status == RUNNING
+            state, lstats = run_segment(
+                state, seg_iters, spec,
+                locked_candidates=locked_candidates, waves=waves,
+                naked_pairs=naked_pairs, packed=packed_planes,
+                legacy_merges=legacy,
+            )
+            lane = jax.lax.psum(lstats.lane_steps, "data")
+            idle = jax.lax.psum(lstats.idle_lane_steps, "data")
+            return state, entry_running, lane, idle
+
+        state_specs = SegmentState(
+            *([data_spec] * len(SegmentState._fields))
+        )
+        sharded = partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(state_specs, data_spec, data_spec, P()),
+            out_specs=(state_specs, data_spec, P(), P()),
+            check_vma=False,
+        )(_run_shard_pipelined)
+
+        def _run_pipelined(state, boards, src, seg_iters):
+            # global source-map alignment (the ONE sentinel-semantics
+            # home, ops/solver.align_src_boards) — a lane may pull its
+            # board from any shard's row, so the gather runs here,
+            # partitioned by GSPMD, not inside the shard body
+            aligned, mask = align_src_boards(boards, src, spec)
+            state, entry_running, lane, idle = sharded(
+                state, aligned, mask, seg_iters
+            )
+            from ..ops.config import segment_prefix_gather
+
+            digest, gathered = segment_digest(
+                state, entry_running, LoopStats(lane, idle),
+                # the ONE shared predicate over the GLOBAL pool's
+                # static byte size, same rule as the single-device
+                # program and the host-side fetch
+                prefix_gather=segment_prefix_gather(
+                    state.grid.shape[0], cells
+                ),
+            )
+            return state, digest, gathered
+
+        return jax.jit(_run_pipelined, donate_argnums=(0,))
 
     def _run_shard(state, boards, inject, seg_iters):
         state = inject_lanes(state, boards, inject, spec)

@@ -196,6 +196,10 @@ class Autopilot:
         # the histo classes are owner-locked by contract)
         self._rtt = LatencyWindow(window=512)
         self._rtt_count = 0
+        # cold-threshold gossip seeding (PR 15 — the PR 14 recorded
+        # limit): times the hedge threshold was answered from a peer's
+        # gossiped farm p99 because local RTT history was still cold
+        self.hedge_gossip_seeds = 0
         self.primary_dispatches = 0
         self.hedges = 0
         self.hedge_wins = 0
@@ -325,13 +329,57 @@ class Autopilot:
 
     def hedge_threshold_s(self) -> float:
         """How long a dispatched cell may straggle before it is hedged:
-        the measured farm-task p99 (floored) once enough history exists,
-        else the conservative cold threshold."""
+        the measured farm-task p99 (floored) once enough history exists;
+        under ``MIN_RTT_SAMPLES`` local folds, a FRESH peer's gossiped
+        farm p99 (telemetry digest ``farm_rtt_p99_ms`` — only nodes
+        with real history publish it) replaces the cold guess, so an
+        idle master inherits the fleet's measured tail instead of
+        keeping the 1 s default forever (the PR 14 recorded limit); the
+        conservative cold threshold only when the whole fleet is cold."""
+        with self._lock:
+            cold = self._rtt_count < MIN_RTT_SAMPLES
+            p99 = (
+                None if cold else self._rtt.summary_ms()["p99_ms"] / 1e3
+            )
+        if p99 is None:
+            # peer telemetry read OUTSIDE our lock (its own lock)
+            p99 = self._gossiped_farm_p99_s()
+            if p99 is None:
+                return self.hedge_cold_s
+            with self._lock:
+                self.hedge_gossip_seeds += 1
+        return max(self.hedge_min_s, p99 * self.hedge_rtt_mult)
+
+    def _gossiped_farm_p99_s(self) -> Optional[float]:
+        """The fleet's measured farm-task p99, from FRESH peer telemetry
+        digests only. The MAX across peers — hedging too eagerly on one
+        fast peer's number is the failure shape; too conservatively just
+        keeps the cold behavior. None when no fresh peer publishes one
+        (digests carry ``farm_rtt_p99_ms`` only past MIN_RTT_SAMPLES
+        local folds — obs/cluster.build_digest — so a fleet of idle
+        masters can never anchor each other to the re-gossiped cold
+        default)."""
+        telemetry = getattr(self.node, "peer_telemetry", None)
+        if telemetry is None:
+            return None
+        vals = []
+        for d in telemetry.snapshot().values():
+            if not d.get("fresh"):
+                continue
+            v = d.get("farm_rtt_p99_ms")
+            if isinstance(v, (int, float)) and 0 < float(v) < 1e7:
+                vals.append(float(v))
+        return max(vals) / 1e3 if vals else None
+
+    def farm_rtt_p99_ms(self) -> Optional[float]:
+        """This node's own MEASURED farm-task RTT p99 for the telemetry
+        digest (obs/cluster.build_digest) — None until MIN_RTT_SAMPLES
+        local folds exist, so the cold guess is never gossiped around
+        the fleet."""
         with self._lock:
             if self._rtt_count < MIN_RTT_SAMPLES:
-                return self.hedge_cold_s
-            p99 = self._rtt.summary_ms()["p99_ms"] / 1e3
-        return max(self.hedge_min_s, p99 * self.hedge_rtt_mult)
+                return None
+            return round(self._rtt.summary_ms()["p99_ms"], 3)
 
     def try_hedge(self) -> bool:
         """Spend one unit of hedge budget, or refuse: lifetime hedges
@@ -453,6 +501,7 @@ class Autopilot:
                     "budget_frac": self.hedge_budget_frac,
                     "rtt_samples": self._rtt_count,
                     "rtt_p99_ms": rtt_ms["p99_ms"],
+                    "gossip_seeds": self.hedge_gossip_seeds,
                 },
                 "join": {
                     "deferred_dials": self.deferred_dials,

@@ -84,12 +84,22 @@ LOST = "lost"
 
 
 class _Token:
-    """One in-flight supervised device call (dispatch → finalized)."""
+    """One in-flight supervised device call (dispatch → finalized).
 
-    __slots__ = ("bucket", "t0", "hung")
+    ``budget_scale`` sizes THIS call's hang budget as a multiple of the
+    global ``watchdog_budget_s`` (PR 15): a pipelined speculative
+    segment is dispatched while the segment ahead of it is still
+    running, so its dispatch→fetch span legitimately covers ~two
+    segments — declared at token-open time by the caller that knows the
+    pipeline depth, so an overlapped dispatch can never read as a hung
+    call while a genuinely stuck one still trips at a bounded (2×)
+    horizon."""
 
-    def __init__(self, bucket: int):
+    __slots__ = ("bucket", "t0", "hung", "budget_scale")
+
+    def __init__(self, bucket: int, budget_scale: float = 1.0):
         self.bucket = bucket
+        self.budget_scale = max(1.0, float(budget_scale))
         self.t0 = time.monotonic()
         self.hung = False
 
@@ -225,13 +235,28 @@ class EngineSupervisor:
             self._callbacks.append(fn)
 
     # -- seam: engine._dispatch_padded / _finalize_padded ------------------
-    def call_started(self, bucket: int):
-        """Open a supervision token around one device call."""
-        tok = _Token(int(bucket))
+    def call_started(self, bucket: int, budget_scale: float = 1.0):
+        """Open a supervision token around one device call.
+        ``budget_scale`` multiplies the watchdog budget for THIS call
+        (see _Token — pipelined segment dispatches pass 2.0)."""
+        tok = _Token(int(bucket), budget_scale)
         with self._lock:
             tid = next(self._token_ids)
             self._inflight[tid] = tok
         return tid
+
+    def call_abandoned(self, token) -> None:
+        """Discard a token without feeding the breaker either way (PR
+        15): a pipelined speculative dispatch thrown away because the
+        segment ahead of it failed was never fetched, so it proves
+        nothing about the device — counting it as a failure would
+        double-step the breaker for one fault, counting it as a success
+        would reset consecutive_failures that the real failure just
+        earned."""
+        if token is None:
+            return
+        with self._lock:
+            self._inflight.pop(token, None)
 
     def call_finished(self, token, ok: bool) -> None:
         """Close a token. A call that was already declared hung counts as
@@ -536,7 +561,8 @@ class EngineSupervisor:
             for tok in self._inflight.values():
                 if (
                     not tok.hung
-                    and now - tok.t0 > self.watchdog_budget_s
+                    and now - tok.t0
+                    > self.watchdog_budget_s * tok.budget_scale
                     and (
                         tok.bucket in self._seen_widths
                         or tok.bucket in warm_widths

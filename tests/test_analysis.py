@@ -645,6 +645,119 @@ def test_jax_jit_in_function_flagged_memoized_factory_clean(tmp_path):
     assert f.symbol == "per_call"
 
 
+def test_jax_donated_buffer_reuse_flagged(tmp_path):
+    """JAX105 (PR 15): reading a name after passing it at a
+    donate_argnums position — the donated array is deleted at dispatch."""
+    findings = run_fixture(
+        tmp_path,
+        {
+            "engine.py": """
+            import jax
+
+            def step(state, x):
+                return state, x
+
+            prog = jax.jit(step, donate_argnums=(0,))
+
+            def bad(state, x):
+                out, y = prog(state, x)
+                return state  # donated — deleted at dispatch
+
+            def bad_rebind_rhs(state, x):
+                out, y = prog(state, x)
+                state = state + 1  # RHS still reads the dead buffer
+                return state
+            """
+        },
+        serving=("engine.py",),
+        analyzers=("jax",),
+    )
+    assert rules_of(findings) == ["JAX105"]
+    assert len(findings) == 2
+    assert {f.symbol for f in findings} == {"bad", "bad_rebind_rhs"}
+
+
+def test_jax_donated_buffer_rebind_patterns_clean(tmp_path):
+    """JAX105 quiet on the blessed patterns: rebinding the name from
+    the donating call's own results (the carried-state loop), a
+    self-attr donating program, reuse of NON-donated arguments, and
+    use strictly after an independent fresh rebind."""
+    findings = run_fixture(
+        tmp_path,
+        {
+            "engine.py": """
+            import jax
+
+            def step(state, x):
+                return state, x
+
+            prog = jax.jit(step, donate_argnums=(0,))
+            undonated = jax.jit(step)
+
+            def carried(state, x):
+                for _ in range(4):
+                    state, y = prog(state, x)
+                return state
+
+            def non_donated_arg_ok(state, x):
+                out, y = prog(state, x)
+                return x  # x's position is not donated
+
+            def fresh_rebind_ok(state, x, make):
+                out, y = prog(state, x)
+                state = make()  # fresh handle, old one never read
+                return state
+
+            def no_donation_ok(state, x):
+                out, y = undonated(state, x)
+                return state
+
+            class Engine:
+                def __init__(self):
+                    self._prog = jax.jit(step, donate_argnums=(0,))
+
+                def run(self, state, x):
+                    state, y = self._prog(state, x)
+                    return state
+            """
+        },
+        serving=("engine.py",),
+        analyzers=("jax",),
+    )
+    assert rules_of(findings) == []
+
+
+def test_jax_donated_self_attr_program_reuse_flagged(tmp_path):
+    """JAX105 tracks self-attribute donating programs (the engine's
+    real shape) and compound statements don't double-count."""
+    findings = run_fixture(
+        tmp_path,
+        {
+            "engine.py": """
+            import jax
+
+            def step(state, x):
+                return state, x
+
+            class Engine:
+                def __init__(self):
+                    self._prog = jax.jit(step, donate_argnums=(0,))
+
+                def bad(self, state, x, flag):
+                    if flag:
+                        out, y = self._prog(state, x)
+                    return state.shape
+            """
+        },
+        serving=("engine.py",),
+        analyzers=("jax",),
+    )
+    assert rules_of(findings) == ["JAX105"]
+    assert len(findings) == 1
+    (f,) = findings
+    assert f.symbol == "Engine.bad"
+
+
 # -- wire schema -------------------------------------------------------------
 
 WIRE_PRODUCER = """

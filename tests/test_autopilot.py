@@ -307,6 +307,40 @@ def test_hedge_threshold_follows_measured_p99():
     assert ap.hedge_threshold_s() == pytest.approx(0.3, abs=0.05)
 
 
+def test_cold_hedge_threshold_seeds_from_gossiped_farm_p99():
+    """The PR 14 recorded limit closed (ISSUE 15 satellite): an idle
+    master with no local RTT history takes its hedge threshold from a
+    FRESH peer's gossiped farm p99 (telemetry digest ``farm_rtt_p99_ms``)
+    instead of keeping the 1 s cold guess forever; the cold default
+    survives only while the whole fleet is cold, local history wins the
+    moment it exists, and only nodes with real history publish the field
+    (a fleet of idle masters can never anchor each other to a re-gossiped
+    default)."""
+    node = fake_node()
+    ap = Autopilot(
+        node, hedge_cold_s=1.0, hedge_min_s=0.1, hedge_rtt_mult=1.0
+    )
+    assert ap.hedge_threshold_s() == 1.0  # whole fleet cold
+    node.peer_telemetry.note("a:1", {"farm_rtt_p99_ms": 300.0})
+    node.peer_telemetry.note("b:2", {"farm_rtt_p99_ms": 450.0})
+    # conservative seed: the MAX across fresh peers
+    assert ap.hedge_threshold_s() == pytest.approx(0.45, abs=1e-9)
+    assert ap.hedge_gossip_seeds >= 1
+    assert ap.snapshot()["hedge"]["gossip_seeds"] >= 1
+    # garbage gossiped values are ignored
+    node.peer_telemetry.note("c:3", {"farm_rtt_p99_ms": -5})
+    node.peer_telemetry.note("d:4", {"farm_rtt_p99_ms": "huge"})
+    assert ap.hedge_threshold_s() == pytest.approx(0.45, abs=1e-9)
+    # local history wins once it exists
+    for _ in range(16):
+        ap.note_farm_rtt(0.2)
+    assert ap.hedge_threshold_s() == pytest.approx(0.2, abs=0.05)
+    # the digest publishes the measured p99 only past the sample floor
+    cold = Autopilot(fake_node())
+    assert cold.farm_rtt_p99_ms() is None
+    assert ap.farm_rtt_p99_ms() == pytest.approx(200.0, rel=0.3)
+
+
 @pytest.fixture
 def spy_master(engine, monkeypatch):
     """A master with three FAKE peers: dispatches are captured, never

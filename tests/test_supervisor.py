@@ -513,6 +513,69 @@ def test_peer_health_map_is_bounded_under_spoofed_flood():
                       f"{PeerHealth.MAX_ENTRIES + 99}")
 
 
+# -- pipelined token sizing + abandonment (PR 15) -----------------------------
+
+
+def test_pipelined_token_budget_scale(engine):
+    """A token opened with budget_scale=2 (a speculative segment whose
+    dispatch→fetch span legitimately covers the segment ahead of it)
+    trips the watchdog only past 2× the budget; a plain token still
+    trips at 1×."""
+    sup = EngineSupervisor(
+        engine,
+        watchdog_budget_s=0.4,
+        breaker_threshold=99,
+        probe_interval_s=600.0,
+    )
+    try:
+        # prove the width so hang detection applies (first-call compile
+        # exemption)
+        t0 = sup.call_started(4)
+        sup.call_finished(t0, ok=True)
+        opened = time.monotonic()
+        plain = sup.call_started(4)
+        piped = sup.call_started(4, budget_scale=2.0)
+        assert wait_for(lambda: sup.hangs >= 1, timeout=3.0)
+        # the 1× token tripped first; the 2× token is still within its
+        # budget — only assertable while we are provably inside its
+        # window (a stalled runner may observe both trips at once)
+        if time.monotonic() - opened < 0.7:
+            assert sup.hangs == 1
+        assert wait_for(lambda: sup.hangs >= 2, timeout=3.0)
+        sup.call_finished(plain, ok=False)
+        sup.call_finished(piped, ok=False)
+    finally:
+        sup.close()
+        engine.supervisor = None
+
+
+def test_abandoned_token_feeds_breaker_nothing(engine):
+    """call_abandoned closes a token without a success OR a failure: a
+    speculative segment thrown away after the segment ahead failed
+    proves nothing about the device."""
+    sup = EngineSupervisor(
+        engine,
+        watchdog_budget_s=0.2,
+        breaker_threshold=99,
+        probe_interval_s=600.0,
+    )
+    try:
+        t0 = sup.call_started(4)
+        sup.call_finished(t0, ok=True)
+        failures0 = sup.failures
+        consec0 = sup.consecutive_failures
+        tok = sup.call_started(4)
+        sup.call_abandoned(tok)
+        assert sup.failures == failures0
+        assert sup.consecutive_failures == consec0
+        # and the discarded token can no longer be declared hung
+        time.sleep(0.5)
+        assert sup.hangs == 0
+    finally:
+        sup.close()
+        engine.supervisor = None
+
+
 # -- injector unit ------------------------------------------------------------
 
 
