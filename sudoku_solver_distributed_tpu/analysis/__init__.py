@@ -11,20 +11,34 @@ so the next cross-thread or cross-host feature cannot quietly regress
 them — serving stacks pair schedulers with correctness tooling, not
 review alone (cf. Orca's batch-scheduler invariants, PAPERS.md).
 
-Three analyzers, all stdlib-``ast``, no third-party deps, no imports of
+Five analyzers, all stdlib-``ast``, no third-party deps, no imports of
 the code under analysis (pure source analysis — safe to run anywhere,
-including hosts without jax):
+including hosts without jax). Since v2 they share one parsed-AST pass
+and one inter-procedural call graph (``callgraph.py``), built once per
+run by the runner:
 
-  * ``locks``       — lock-discipline: lock-order cycles, blocking calls
-                      while holding a lock, condition-on-foreign-lock,
-                      guarded-attribute write races (LOCK1xx).
+  * ``locks``       — lock-discipline: lock-order cycles (per-class AND
+                      cross-class along call-graph edges), blocking
+                      calls while holding a lock, condition-on-foreign-
+                      lock, guarded-attribute write races (LOCK1xx).
   * ``jax_hygiene`` — serving-path JAX hygiene: implicit host syncs on
                       device values, Python branches on traced values,
                       non-hashable static args, uncached jit factories
                       (JAX1xx).
   * ``wire_schema`` — wire-protocol drift: the key sets each ``wire.py``
                       constructor produces vs the keys each UDP handler
-                      consumes, per message ``type`` (WIRE1xx).
+                      consumes, per message ``type`` (WIRE1xx); consumer
+                      modules are auto-discovered from the call graph.
+  * ``seams``       — dispatch-contract coverage: every route-core →
+                      jit-invocation path, per dispatch shape, must
+                      carry supervision, trace, cost, deadline and
+                      fallback legs (SEAM1xx); also emits the
+                      five-shape contract matrix (``--json``) the
+                      planned ExecutionPlane refactor consumes.
+  * ``threadctx``   — thread-context hazards: expensive or indefinitely
+                      blocking work reachable on singleton loop threads
+                      (UDP loop, coalescer drivers, watchdog)
+                      (THREAD1xx).
 
 Usage::
 
@@ -51,17 +65,21 @@ from .findings import (  # noqa: F401
     load_baseline,
 )
 from .runner import (  # noqa: F401
+    AnalysisResult,
     Config,
     default_config,
+    run_analysis,
     run_analyzers,
 )
 
 __all__ = [
+    "AnalysisResult",
     "BaselineEntry",
     "Config",
     "Finding",
     "apply_baseline",
     "default_config",
     "load_baseline",
+    "run_analysis",
     "run_analyzers",
 ]

@@ -20,19 +20,42 @@ from pathlib import Path
 from typing import List, Optional
 
 from .findings import apply_baseline, load_baseline
-from .runner import Config, default_config, run_analyzers
+from .runner import Config, default_config, run_analysis
+from .sarif import to_sarif
+from .seams import MATRIX_SCHEMA_VERSION
 
 # rule-id prefix per analyzer: a partial --rules run must only judge the
 # baseline entries its analyzers could have re-confirmed
-_RULE_PREFIXES = {"locks": "LOCK", "jax": "JAX", "wire": "WIRE"}
+_RULE_PREFIXES = {
+    "locks": "LOCK",
+    "jax": "JAX",
+    "wire": "WIRE",
+    "seams": "SEAM",
+    "thread": "THREAD",
+}
+
+# --json output contract (pinned by test_cli_json_schema_pinned): the
+# ExecutionPlane tooling consumes contract_matrix, so additions bump
+# JSON_SCHEMA_VERSION and removals/renames are breaking
+JSON_SCHEMA_VERSION = 2
+_JSON_KEYS = (
+    "schema_version",
+    "errors",
+    "warnings",
+    "suppressed",
+    "stale_baseline",
+    "contract_matrix",
+    "wire_consumers",
+)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sudoku_solver_distributed_tpu.analysis",
         description=(
-            "graftcheck: lock-discipline, JAX-hygiene and wire-schema "
-            "static analysis for this repo"
+            "graftcheck: lock-discipline, JAX-hygiene, wire-schema, "
+            "dispatch-seam and thread-context static analysis for "
+            "this repo"
         ),
     )
     parser.add_argument(
@@ -62,8 +85,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--rules",
-        default="locks,jax,wire",
-        help="comma-separated analyzer subset (locks,jax,wire)",
+        default="locks,jax,wire,seams,thread",
+        help="comma-separated analyzer subset "
+        "(locks,jax,wire,seams,thread)",
+    )
+    parser.add_argument(
+        "--sarif",
+        type=Path,
+        default=None,
+        help="also write findings as SARIF 2.1.0 to this path "
+        "(uploaded by CI so findings annotate PRs inline)",
     )
     args = parser.parse_args(argv)
 
@@ -101,7 +132,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         analyzers=rules,
     )
 
-    findings = run_analyzers(cfg)
+    result = run_analysis(cfg)
+    findings = result.findings
     try:
         entries = (
             load_baseline(cfg.baseline) if cfg.baseline is not None else []
@@ -118,18 +150,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     errors = [f for f in active if f.severity == "error"]
     warnings = [f for f in active if f.severity == "warning"]
 
-    if args.json:
-        print(
-            json.dumps(
-                {
-                    "errors": [vars(f) for f in errors],
-                    "warnings": [vars(f) for f in warnings],
-                    "suppressed": [vars(f) for f in suppressed],
-                    "stale_baseline": [vars(e) for e in stale],
-                },
-                indent=2,
-            )
+    if args.sarif is not None:
+        args.sarif.write_text(
+            json.dumps(to_sarif(active, suppressed), indent=2) + "\n"
         )
+
+    if args.json:
+        payload = {
+            "schema_version": JSON_SCHEMA_VERSION,
+            "errors": [vars(f) for f in errors],
+            "warnings": [vars(f) for f in warnings],
+            "suppressed": [vars(f) for f in suppressed],
+            "stale_baseline": [vars(e) for e in stale],
+            # the five-shape × five-leg dispatch-contract inventory
+            # (seams.MATRIX_SCHEMA_VERSION inside; {} if seams not run)
+            "contract_matrix": result.contract_matrix,
+            "wire_consumers": list(result.wire_consumers),
+        }
+        assert set(payload) == set(_JSON_KEYS)
+        assert (
+            not result.contract_matrix
+            or result.contract_matrix["schema_version"]
+            == MATRIX_SCHEMA_VERSION
+        )
+        print(json.dumps(payload, indent=2))
     else:
         for f in active:
             print(f.format())

@@ -33,10 +33,21 @@ Rules:
                     than the condition's own: the wait releases only its
                     own lock, so everything else stays held for the full
                     sleep.
+  LOCK106 (error)   CROSS-CLASS lock-order cycle (:func:`analyze_cross`,
+                    over the shared call graph): class A calls into
+                    class B while holding an A-lock and B's method
+                    (transitively) acquires a B-lock, while some B
+                    method does the reverse — the coalescer↔engine↔
+                    admission interleaving per-class analysis cannot
+                    see. Calls are matched to call-graph edges by
+                    (line, name), so only resolvable package methods
+                    participate.
 
 Scope limits (deliberate, documented): attribute-level tracking only
-(lock objects passed around in locals are not followed), intra-class
-call graphs only (``self.other_object.method()`` is not traversed), and
+(lock objects passed around in locals are not followed), per-class
+rules use intra-class call graphs only (cross-object calls are the
+LOCK106 pass's job, and that pass follows one cross-class hop from a
+held region into the callee's transitive intra-class acquisitions), and
 nested ``def``s are analyzed with the locks held at their definition
 site (a closure defined under a lock is almost always called under it
 in this codebase's dispatcher/handler idiom).
@@ -46,7 +57,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 from ._astutil import (
     Module,
@@ -117,6 +128,11 @@ class _MethodInfo:
     edges: List[Tuple[str, str, int]] = dataclasses.field(default_factory=list)
     blocks: List[_Block] = dataclasses.field(default_factory=list)
     self_calls: List[Tuple[str, Tuple[str, ...], int]] = dataclasses.field(
+        default_factory=list
+    )
+    # attr calls on OTHER objects made while holding locks, for the
+    # cross-class pass: (method name, held locks, line)
+    ext_calls: List[Tuple[str, Tuple[str, ...], int]] = dataclasses.field(
         default_factory=list
     )
     writes: List[Tuple[str, FrozenSet[str], int]] = dataclasses.field(
@@ -337,6 +353,10 @@ class _MethodWalker:
         ):
             self.info.self_calls.append((method, held, call.lineno))
             return
+        # anything else reached under a lock is a candidate cross-class
+        # call: analyze_cross resolves it against the call graph
+        if held:
+            self.info.ext_calls.append((method, held, call.lineno))
         # Future.result() on any receiver: .result( is unambiguous in
         # this codebase (concurrent.futures) and blocks until completion
         if method == "result":
@@ -593,24 +613,137 @@ def _guarded_attr_findings(
     return findings
 
 
+def _class_pass(
+    mod: Module, cls: ast.ClassDef
+) -> Optional[Tuple[Dict[str, _Attr], Dict[str, _MethodInfo]]]:
+    """Walk one class's methods; None when the class holds no locks."""
+    methods = methods_of(cls)
+    attrs = _collect_attr_types(mod, methods)
+    if not any(
+        a.kind in (LOCK, RLOCK, CONDITION) for a in attrs.values()
+    ):
+        return None  # lock-free class: nothing to check
+    infos: Dict[str, _MethodInfo] = {}
+    for name, fn in methods.items():
+        info = _MethodInfo(name)
+        walker = _MethodWalker(
+            mod, cls.name, attrs, set(methods), info, mod.rel_path
+        )
+        walker.walk_body(fn.body, ())
+        infos[name] = info
+    return attrs, infos
+
+
 def analyze_module(mod: Module) -> List[Finding]:
     findings: List[Finding] = []
     for cls in mod.classes():
-        methods = methods_of(cls)
-        attrs = _collect_attr_types(mod, methods)
-        if not any(
-            a.kind in (LOCK, RLOCK, CONDITION) for a in attrs.values()
-        ):
-            continue  # lock-free class: nothing to check
-        infos: Dict[str, _MethodInfo] = {}
-        for name, fn in methods.items():
-            info = _MethodInfo(name)
-            walker = _MethodWalker(
-                mod, cls.name, attrs, set(methods), info, mod.rel_path
-            )
-            walker.walk_body(fn.body, ())
-            infos[name] = info
+        passed = _class_pass(mod, cls)
+        if passed is None:
+            continue
+        attrs, infos = passed
+        for info in infos.values():
             findings.extend(info.findings)
         findings.extend(_transitive(infos, attrs, cls.name, mod.rel_path))
         findings.extend(_guarded_attr_findings(infos, cls.name, mod.rel_path))
+    return findings
+
+
+def _transitive_acquires(
+    infos: Dict[str, _MethodInfo]
+) -> Dict[str, Set[str]]:
+    """Fixed point of each method's acquired locks through intra-class
+    calls (the cross-class pass needs what a callee EVENTUALLY locks)."""
+    acq = {m: set(info.acquires) for m, info in infos.items()}
+    changed = True
+    while changed:
+        changed = False
+        for m, info in infos.items():
+            for callee, _held, _line in info.self_calls:
+                extra = acq.get(callee, set()) - acq[m]
+                if extra:
+                    acq[m] |= extra
+                    changed = True
+    return acq
+
+
+def analyze_cross(modules: Sequence[Module], graph) -> List[Finding]:
+    """LOCK106: lock-order cycles ACROSS classes, along call-graph edges.
+
+    Per-class analysis sees ``with self._lock: self.engine.admit(...)``
+    as an opaque external call. Here every such held-region call is
+    matched (by line + method name) to its resolved call-graph edges;
+    when the callee is a method of ANOTHER lock-holding class, the
+    caller's held locks order before everything the callee transitively
+    acquires. Opposite-direction edge pairs over these class-qualified
+    locks are the coalescer↔engine↔admission ABBA deadlocks that are
+    invisible per-class.
+    """
+    # one _class_pass per lock class, keyed like call-graph nodes
+    per_class: Dict[Tuple[str, str], Tuple[Dict[str, _Attr], Dict[str, _MethodInfo]]] = {}
+    for mod in modules:
+        for cls in mod.classes():
+            passed = _class_pass(mod, cls)
+            if passed is not None:
+                per_class[(mod.rel_path, cls.name)] = passed
+    acq_of = {
+        key: _transitive_acquires(infos)
+        for key, (_attrs, infos) in per_class.items()
+    }
+
+    # call-graph edges indexed by (caller key, line, callee name)
+    edge_map: Dict[Tuple[str, int, str], List[str]] = {}
+    for key, sites in graph.edges.items():
+        for target, site in sites:
+            edge_map.setdefault((key, site.line, site.name), []).append(
+                target
+            )
+
+    # cross edges over class-qualified locks: "Cls.lockattr"
+    cross: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+    for (rel_path, cls_name), (_attrs, infos) in per_class.items():
+        for m, info in infos.items():
+            caller_key = f"{rel_path}::{cls_name}.{m}"
+            for name, held, line in info.ext_calls:
+                for target in edge_map.get((caller_key, line, name), ()):
+                    node = graph.nodes.get(target)
+                    if node is None or node.cls_name is None:
+                        continue
+                    callee_cls = (node.mod.rel_path, node.cls_name)
+                    if callee_cls == (rel_path, cls_name):
+                        continue  # intra-class: LOCK101's job
+                    callee_acq = acq_of.get(callee_cls, {}).get(
+                        node.fn.name, set()
+                    )
+                    for la in held:
+                        qa = f"{cls_name}.{la}"
+                        for lb in sorted(callee_acq):
+                            qb = f"{node.cls_name}.{lb}"
+                            cross.setdefault(
+                                (qa, qb),
+                                (rel_path, f"{cls_name}.{m}", line),
+                            )
+
+    findings: List[Finding] = []
+    reported: Set[FrozenSet[str]] = set()
+    for (qa, qb) in sorted(cross):
+        pair = frozenset((qa, qb))
+        if (qb, qa) not in cross or pair in reported:
+            continue
+        reported.add(pair)
+        path, symbol, line = cross[(qa, qb)]
+        path2, symbol2, line2 = cross[(qb, qa)]
+        findings.append(
+            Finding(
+                "LOCK106",
+                "error",
+                path,
+                line,
+                symbol,
+                f"cross-class lock-order cycle: {qa} held while "
+                f"calling into code that acquires {qb} here, but "
+                f"{symbol2} ({path2}:{line2}) holds {qb} while "
+                f"reaching {qa} — ABBA deadlock across classes",
+            )
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -13,12 +13,14 @@ surfaces, both speaking the existing UDP protocol's idioms:
   * **cache_get / cache_answer** — a node that MISSES locally on a key
     some fresh peer advertises sends ``cache_get`` and waits a bounded
     beat for the ``cache_answer`` carrying the canonical (board,
-    solution) pair. The answer is verified on arrival through the
-    store's write gate (cache/store.py ``store_canonical``: re-hashed
-    under OUR canonicalization, rule-checked host-side), so a hostile or
-    corrupt peer answer is counted and dropped, never served. The fetch
-    replaces a device dispatch; a timeout just falls through to the
-    normal solve path.
+    solution) pair. The UDP ingress thread only DELIVERS the payload to
+    the parked fetcher (bounded append + event set — the receive loop
+    never canonicalizes, THREAD101); the fetcher thread verifies it
+    through the store's write gate (cache/store.py ``store_canonical``:
+    re-hashed under OUR canonicalization, rule-checked host-side), so a
+    hostile or corrupt peer answer is counted and dropped, never
+    served. The fetch replaces a device dispatch; a timeout just falls
+    through to the normal solve path.
 
 Net effect: one node solves the viral puzzle, every node answers its
 whole symmetry orbit from cache within a gossip interval.
@@ -114,6 +116,23 @@ class PeerHotset(PeerMap):
         }
 
 
+class _Waiter:
+    """One key's parked fetchers: the wake event, how many threads are
+    registered on it, and the raw answer payloads delivered by the UDP
+    loop awaiting verification on a fetcher thread. Payloads are capped:
+    a flood of answers for a solicited key can park at most
+    ``MAX_PAYLOADS`` boards here, not grow the heap."""
+
+    MAX_PAYLOADS = 4
+
+    __slots__ = ("event", "count", "payloads")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.count = 0
+        self.payloads: List[Tuple[object, object]] = []
+
+
 class CacheGossip:
     """One node's cache-convergence plane: builds the outgoing hot-set
     digest (cached between heartbeats, like obs/cluster's publisher),
@@ -170,9 +189,10 @@ class CacheGossip:
         self._digest_lock = threading.Lock()
         self._cached_digest: Optional[dict] = None
         self._cached_at = 0.0
-        # key -> (threading.Event, waiter count); signaled by
-        # on_cache_answer after a verified fold lands under that key
-        self._waiters: Dict[str, Tuple[threading.Event, int]] = {}
+        # key -> _Waiter; on_cache_answer appends the RAW payload and
+        # signals — the waiting fetcher thread verifies (the UDP loop
+        # must never canonicalize)
+        self._waiters: Dict[str, _Waiter] = {}
         self._waiters_lock = threading.Lock()
         self.peer_serves = 0  # cache_get datagrams answered (benign race)
 
@@ -240,34 +260,73 @@ class CacheGossip:
         self.peer_serves += 1
 
     def on_cache_answer(self, msg) -> None:
-        """Fold a peer's answer through the store's write gate, then
-        wake the fetch waiting on that key. The claimed hash is never
-        trusted: store_canonical re-canonicalizes the carried board, so
-        the entry lands under the key WE compute — the waiter's
-        post-wake ``contains`` check closes the loop.
+        """Deliver a peer's answer to the fetch parked on that key and
+        wake it. This runs on the UDP receive loop, so it does ONLY
+        O(1) work — a bounded payload append and an event set; the
+        woken fetcher thread runs the store's write gate
+        (``_verify_delivered`` → store_canonical), where the claimed
+        hash is never trusted: the carried board is re-canonicalized so
+        the entry lands under the key WE compute, and the waiter's
+        post-verify ``contains`` check closes the loop.
 
         SOLICITED answers only: a datagram for a key no fetch is
-        waiting on is dropped before any verification runs. Without the
-        gate, an attacker streaming valid-but-unsolicited (board,
-        solution) pairs — trivial to mint from any complete grid —
-        would both flush the genuine hot set through the per-shard LRU
-        and burn ~0.5 ms of canonicalize+verify on the UDP ingress
-        thread per datagram, starving heartbeat/membership processing.
-        Waiters register BEFORE the gets go out (try_peer_fetch), so a
-        legitimate answer always finds its waiter; late answers after
-        the timeout are dropped like any other unsolicited datagram
-        (the asking node will re-fetch or has already dispatched)."""
+        waiting on is dropped on arrival. Without the gate, an attacker
+        streaming valid-but-unsolicited (board, solution) pairs —
+        trivial to mint from any complete grid — would flush the
+        genuine hot set through the per-shard LRU; the delivery cap
+        (``_Waiter.MAX_PAYLOADS``) bounds what a flood on a SOLICITED
+        key can park. Waiters register BEFORE the gets go out
+        (try_peer_fetch), so a legitimate answer always finds its
+        waiter; late answers after the timeout are dropped like any
+        other unsolicited datagram (the asking node will re-fetch or
+        has already dispatched)."""
         key = valid_key(msg["hash"])
         if key is None:
             return
+        board, solution = msg["board"], msg["solution"]
         with self._waiters_lock:
             entry = self._waiters.get(key)
+            if entry is None:
+                self.unsolicited_answers += 1  # benign-race counter
+                return
+            if len(entry.payloads) < _Waiter.MAX_PAYLOADS:
+                entry.payloads.append((board, solution))
+            entry.event.set()
+
+    # -- waiter bookkeeping (fetcher threads) ------------------------------
+    def _register_waiter(self, key: str) -> _Waiter:
+        """Caller holds ``_waiters_lock``."""
+        entry = self._waiters.get(key)
         if entry is None:
-            self.unsolicited_answers += 1  # benign-race counter
-            return
-        if not self.cache.store_canonical(msg["board"], msg["solution"]):
-            return
-        entry[0].set()
+            entry = self._waiters[key] = _Waiter()
+        entry.count += 1
+        return entry
+
+    def _release_waiter(self, key: str) -> None:
+        """Drop one registration; the last one out verifies any
+        payloads still parked (an answer that raced the timeout should
+        still land for the NEXT request) and removes the entry."""
+        self._verify_delivered(key)
+        with self._waiters_lock:
+            entry = self._waiters.get(key)
+            if entry is None:
+                return
+            entry.count -= 1
+            if entry.count <= 0:
+                self._waiters.pop(key, None)
+
+    def _verify_delivered(self, key: str) -> bool:
+        """Run delivered payloads through the store's write gate — on
+        the CALLING (fetcher) thread, never the UDP loop. True iff a
+        payload verified and landed."""
+        while True:
+            with self._waiters_lock:
+                entry = self._waiters.get(key)
+                if entry is None or not entry.payloads:
+                    return False
+                board, solution = entry.payloads.pop(0)
+            if self.cache.store_canonical(board, solution):
+                return True
 
     # -- the front door's fetch (handler thread) ---------------------------
     def try_peer_fetch(self, key: str, timeout_s=None) -> bool:
@@ -298,8 +357,7 @@ class CacheGossip:
                 self.fetches_capped += 1
                 return False
             self._fetching += 1
-            ev, count = self._waiters.get(key, (threading.Event(), 0))
-            self._waiters[key] = (ev, count + 1)
+            entry = self._register_waiter(key)
         try:
             self.cache._count("peer_fetches")
             msg = wire.cache_get_msg(key, self.node.id)
@@ -314,15 +372,23 @@ class CacheGossip:
                 targets.append(rest[self._fetch_rotation % len(rest)])
             for peer in targets:
                 self.node.send_to(peer, msg)
-            ev.wait(wait_s)
+            deadline = time.monotonic() + wait_s
+            while not self.cache.contains(key):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not entry.event.wait(remaining):
+                    break  # budget spent with no delivery
+                if self._verify_delivered(key):
+                    break  # verified fold landed under our key
+                # a hostile/corrupt answer must not end the wait early:
+                # re-arm and keep waiting for an honest one — unless a
+                # further delivery raced in while we were verifying
+                with self._waiters_lock:
+                    if not entry.payloads:
+                        entry.event.clear()
         finally:
             with self._waiters_lock:
                 self._fetching -= 1
-                ev2, count2 = self._waiters.get(key, (ev, 1))
-                if count2 <= 1:
-                    self._waiters.pop(key, None)
-                else:
-                    self._waiters[key] = (ev2, count2 - 1)
+            self._release_waiter(key)
         return self.cache.contains(key)
 
     # -- joiner prewarm (ISSUE 14 satellite) -------------------------------
@@ -374,14 +440,10 @@ class CacheGossip:
         # register every waiter BEFORE any get goes out (the solicited-
         # answers gate in on_cache_answer) — same discipline as
         # try_peer_fetch, shared waiter table
-        events = {}
+        entries = {}
         with self._waiters_lock:
             for k in wanted:
-                ev, count = self._waiters.get(
-                    k, (threading.Event(), 0)
-                )
-                self._waiters[k] = (ev, count + 1)
-                events[k] = ev
+                entries[k] = self._register_waiter(k)
         sent_per_peer: Dict[str, int] = {}
         try:
             asked = []
@@ -410,17 +472,15 @@ class CacheGossip:
                 remaining = t_end - time.monotonic()
                 if remaining <= 0:
                     break
-                events[k].wait(remaining)
+                if entries[k].event.wait(remaining):
+                    # fold the delivery on THIS thread; the UDP loop
+                    # only parked the raw payload
+                    self._verify_delivered(k)
         finally:
-            with self._waiters_lock:
-                for k in wanted:
-                    ev2, count2 = self._waiters.get(
-                        k, (events[k], 1)
-                    )
-                    if count2 <= 1:
-                        self._waiters.pop(k, None)
-                    else:
-                        self._waiters[k] = (ev2, count2 - 1)
+            for k in wanted:
+                # _release_waiter drains any answer that raced the
+                # budget before dropping the registration
+                self._release_waiter(k)
         landed = sum(1 for k in wanted if self.cache.contains(k))
         self.prewarm_landed += landed
         return len(wanted), landed

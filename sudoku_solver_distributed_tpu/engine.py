@@ -2634,35 +2634,71 @@ class SolverEngine:
         boundary check in ``solve_one`` — the serving loop's broadcast
         wire carries a bare board, so a deadline cannot follow the
         request across hosts yet (known limit; the round-trip is bounded
-        by the loop's own timeout either way)."""
-        if self.frontier_runner is not None:
-            # multi-host race: the loop's round-trip IS this request's
-            # device stage (the local branch is stamped finer inside
-            # frontier_solve — seeding as coalesce, the race as device)
-            tr = current_trace()
-            t_dev = time.monotonic()
-            try:
-                solution, info = self.frontier_runner(arr)
-            finally:
-                if tr is not None:
-                    tr.mark("device", time.monotonic() - t_dev)
-        else:
-            from .parallel import frontier_solve
+        by the loop's own timeout either way).
 
-            packed, legacy = self._loop_flavor()
-            solution, info = frontier_solve(
-                arr,
-                self.frontier_mesh,
-                self.spec,
-                states_per_device=self.frontier_states_per_device,
-                max_depth=self.max_depth,
-                locked=self.locked_candidates,
-                waves=self.waves,
-                naked_pairs=self.naked_pairs,
-                packed=packed,
-                legacy_merges=legacy,
-                initial_states=seed_states,
-                deadline_s=deadline_s,
+        Supervision + cost: the race opens a watchdog token under the
+        sentinel width 0 (it is not a bucket program, but a hung mesh
+        race must trip the same breaker the bucket seam feeds) with a
+        scaled budget — a healthy race legitimately runs far past a
+        single bucket call — and folds its wall time into
+        ``cost.note_frontier`` on completion, so the frontier dispatch
+        shape carries the supervision and cost legs of the dispatch
+        contract (analysis/seams.py)."""
+        from .serving.admission import DeadlineExceeded
+
+        sup = self.supervisor
+        token = (
+            sup.call_started(0, budget_scale=8.0)
+            if sup is not None
+            else None
+        )
+        t0 = time.monotonic()
+        try:
+            if self.frontier_runner is not None:
+                # multi-host race: the loop's round-trip IS this request's
+                # device stage (the local branch is stamped finer inside
+                # frontier_solve — seeding as coalesce, the race as device)
+                tr = current_trace()
+                t_dev = time.monotonic()
+                try:
+                    solution, info = self.frontier_runner(arr)
+                finally:
+                    if tr is not None:
+                        tr.mark("device", time.monotonic() - t_dev)
+            else:
+                from .parallel import frontier_solve
+
+                packed, legacy = self._loop_flavor()
+                solution, info = frontier_solve(
+                    arr,
+                    self.frontier_mesh,
+                    self.spec,
+                    states_per_device=self.frontier_states_per_device,
+                    max_depth=self.max_depth,
+                    locked=self.locked_candidates,
+                    waves=self.waves,
+                    naked_pairs=self.naked_pairs,
+                    packed=packed,
+                    legacy_merges=legacy,
+                    initial_states=seed_states,
+                    deadline_s=deadline_s,
+                )
+        except DeadlineExceeded:
+            # a policy abort proves nothing about the device: discard
+            # without feeding the breaker either way
+            if sup is not None:
+                sup.call_abandoned(token)
+            raise
+        except BaseException:
+            if sup is not None:
+                sup.call_finished(token, ok=False)
+            raise
+        if sup is not None:
+            sup.call_finished(token, ok=True)
+        if self.cost is not None:
+            self.cost.note_frontier(
+                device_s=time.monotonic() - t0,
+                escalated=seed_states is not None,
             )
         return solution, dict(info, frontier=True)
 

@@ -386,7 +386,9 @@ class FastHTTPServer:
                 return status, payload, False, degraded, cached
             if path == "/solve_batch" and self.expose_batch:
                 status, payload, error, degraded, cached = (
-                    http_api.solve_batch_route(node, body)
+                    http_api.solve_batch_route(
+                        node, body, deadline_ms=deadline_ms
+                    )
                 )
                 self._record("/solve_batch", t0, error=error)
                 return status, payload, False, degraded, cached

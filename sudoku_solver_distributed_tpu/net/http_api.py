@@ -400,7 +400,7 @@ def _solve_core(
     )
 
 
-def solve_batch_route(p2p_node, body: bytes):
+def solve_batch_route(p2p_node, body: bytes, deadline_ms=None):
     """POST /solve_batch (opt-in extension, not a reference surface): the
     engine's bucketed batch path over HTTP — the framework's headline
     strength (bench.py throughput) reachable by a serving client, instead
@@ -421,7 +421,17 @@ def solve_batch_route(p2p_node, body: bytes):
     STRIP OUT of the batch before coalescing — only the misses pay
     admission into the engine's batch path — and their answers merge
     back in request order. ``cached`` is the any-board summary (the
-    ``X-Cache: hit`` header); the body shape is unchanged."""
+    ``X-Cache: hit`` header); the body shape is unchanged.
+
+    ``deadline_ms`` is the request's relative latency budget (the
+    ``X-Deadline-Ms`` header, parsed by the transport — the batch
+    shape's deadline leg of the dispatch contract, analysis/seams.py):
+    a budget already expired at arrival, or exhausted by validation and
+    the cache consult, sheds 429 BEFORE the engine dispatch — the
+    device never runs a batch nobody is waiting for. An all-hit batch
+    never sheds: the answers are already in hand. Without the header,
+    behavior is unchanged."""
+    t_arrival = time.monotonic()
     try:
         sudokus = json.loads(body.decode())["sudokus"]
     except (ValueError, KeyError, TypeError, UnicodeDecodeError):
@@ -462,6 +472,29 @@ def solve_batch_route(p2p_node, body: bytes):
     capped = 0
     solved = n - len(miss_idx)
     if miss_idx:
+        if deadline_ms is not None:
+            # pre-dispatch expiry check (the contract's deadline leg):
+            # validation + the cache consult are charged against the
+            # client's budget, and a batch whose budget they exhausted
+            # sheds here — mid-batch the chunks run to completion (a
+            # batch is one dispatch unit; per-chunk abandonment would
+            # waste the device work already queued)
+            remaining_ms = (
+                deadline_ms - (time.monotonic() - t_arrival) * 1e3
+            )
+            if remaining_ms <= 0:
+                adm = getattr(p2p_node, "admission", None)
+                retry = (
+                    adm.retry_hint_s() if adm is not None else None
+                )
+                logger.debug("shed /solve_batch: deadline expired")
+                return (
+                    429,
+                    _shed_payload("Deadline exceeded", retry),
+                    True,
+                    False,
+                    False,
+                )
         solutions, mask, info = p2p_node.batch_sudoku_solve(
             [sudokus[i] for i in miss_idx]
         )
@@ -905,7 +938,12 @@ class SudokuHTTPHandler(BaseHTTPRequestHandler):
             )
             try:
                 status, payload, error, degraded, cached = (
-                    solve_batch_route(self.p2p_node, post_data)
+                    solve_batch_route(
+                        self.p2p_node, post_data,
+                        deadline_ms=_parse_deadline_ms(
+                            self.headers.get("X-Deadline-Ms")
+                        ),
+                    )
                 )
             except BaseException:
                 finish_trace(self.p2p_node, trace, 500)

@@ -733,15 +733,45 @@ class P2PNode:
                     self._solved_count += 1
             self.broadcast_stats()
             return solution, info
-        with self._solve_lock:
-            solution, info = self._farm_solve(
-                sudoku, peers, deadline_s=deadline_s
-            )
-            if solution is not None:
-                with self._state_lock:
-                    self._solved_count += 1
-            self.broadcast_stats()
-            return solution, info
+        from ..serving.admission import DeadlineExceeded
+
+        sup = getattr(self.engine, "supervisor", None)
+        # the farm shape's supervision leg (analysis/seams.py SEAM101):
+        # a watchdog token over the whole farm round, under the sentinel
+        # width -1 (a farm is not a bucket program) with a scaled budget
+        # — peer round trips legitimately outlast a device call, but a
+        # farm stuck requeueing dead peers forever must still be
+        # declared hung and feed the breaker like any other dispatch
+        token = (
+            sup.call_started(-1, budget_scale=8.0)
+            if sup is not None
+            else None
+        )
+        try:
+            with self._solve_lock:
+                solution, info = self._farm_solve(
+                    sudoku, peers, deadline_s=deadline_s
+                )
+        except DeadlineExceeded:
+            # a policy abort proves nothing about the peers or the
+            # device: discard without feeding the breaker either way
+            if sup is not None:
+                sup.call_abandoned(token)
+            raise
+        except BaseException:
+            if sup is not None:
+                sup.call_finished(token, ok=False)
+            raise
+        if sup is not None:
+            sup.call_finished(token, ok=True)
+        # counter + gossip OUTSIDE _solve_lock (same discipline as the
+        # engine-path branch above — broadcast_stats sends datagrams,
+        # and a sendto under the solve lock is the LOCK102 class)
+        if solution is not None:
+            with self._state_lock:
+                self._solved_count += 1
+        self.broadcast_stats()
+        return solution, info
 
     def batch_sudoku_solve(self, sudokus):
         """Solve many boards in one engine batch (the opt-in
@@ -1076,9 +1106,19 @@ class P2PNode:
                 # board unsat — replaces the reference's swap-repair
                 # (node.py:487-532) — or (b) every worker departed mid-solve
                 # (the reference would dispatch to dead peers forever).
-                solution, info = self.engine.solve_one(
-                    sudoku, frontier=False
-                )
+                # Under an open breaker the supervised host-oracle
+                # fallback answers instead — the terminal solve of a
+                # degraded master must not touch the quarantined device
+                # (the farm shape's fallback leg, analysis/seams.py)
+                sup = getattr(self.engine, "supervisor", None)
+                if sup is not None and sup.should_fallback():
+                    solution, info = sup.fallback_solve(
+                        sudoku, deadline_s=deadline_s
+                    )
+                else:
+                    solution, info = self.engine.solve_one(
+                        sudoku, frontier=False
+                    )
                 return solution, dict(info, farmed=True)
 
             if done:
@@ -1088,8 +1128,16 @@ class P2PNode:
             return None, {"routed": "farm"}
         # strict final check on the engine (reference runs its weak check,
         # node.py:466); its info rides back so a supervised fallback
-        # answer keeps its degraded flag through the farm path
-        solution, info = self.engine.solve_one(board, frontier=False)
+        # answer keeps its degraded flag through the farm path. Open
+        # breaker → the host oracle verifies/solves instead (same
+        # fallback-leg contract as the unsat-retry branch above)
+        sup = getattr(self.engine, "supervisor", None)
+        if sup is not None and sup.should_fallback():
+            solution, info = sup.fallback_solve(
+                board, deadline_s=deadline_s
+            )
+        else:
+            solution, info = self.engine.solve_one(board, frontier=False)
         return solution, dict(info, farmed=True)
 
     @staticmethod

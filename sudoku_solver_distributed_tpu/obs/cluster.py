@@ -47,8 +47,11 @@ def build_digest(node, prev: Optional[tuple] = None) -> tuple:
 
     served = shed = 0
     metrics = getattr(node, "metrics", None)
-    if metrics is not None and hasattr(metrics, "summary"):
-        for route, entry in metrics.summary().items():
+    # counts(), not summary(): the digest needs only the counters, and
+    # this runs on the UDP gossip loop — summary() sorts every route's
+    # sample window per call (THREAD104, the PR 15 driver-stall class)
+    if metrics is not None and hasattr(metrics, "counts"):
+        for route, entry in metrics.counts().items():
             if not route.startswith("/"):
                 continue
             # goodput = answered useful work: sheds are recorded with
@@ -74,13 +77,18 @@ def build_digest(node, prev: Optional[tuple] = None) -> tuple:
 
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
-        stages = tracer.stages.summary()
-        total = stages.get("total", {})
-        device = stages.get("device", {})
-        digest["p50_ms"] = total.get("p50_ms", 0.0)
-        digest["p99_ms"] = total.get("p99_ms", 0.0)
-        digest["device_p50_ms"] = device.get("p50_ms", 0.0)
-        digest["device_p99_ms"] = device.get("p99_ms", 0.0)
+        # histogram-estimated quantiles (O(buckets)), NOT summary()'s
+        # exact window percentiles (O(n log n) sort per stage) — gossip-
+        # grade precision on the gossip thread; /metrics keeps the exact
+        # ones on its pull path
+        p50, p99 = tracer.stages.digest_quantiles("total", (0.5, 0.99))
+        dev_p50, dev_p99 = tracer.stages.digest_quantiles(
+            "device", (0.5, 0.99)
+        )
+        digest["p50_ms"] = p50
+        digest["p99_ms"] = p99
+        digest["device_p50_ms"] = dev_p50
+        digest["device_p99_ms"] = dev_p99
 
     engine = getattr(node, "engine", None)
     if engine is not None:

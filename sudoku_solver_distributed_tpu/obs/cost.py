@@ -116,6 +116,11 @@ class CostAccounting:
         # inflate a measured completion rate (the PR 2 malformed-flood
         # guard's failure shape, from the dispatch side)
         self._farm = {"dispatches": 0, "hedges": 0, "dup_solutions": 0}
+        # frontier-route counters: races run, quick-probe escalations
+        # among them, and the races' wall time — the frontier dispatch
+        # shape's cost leg (analysis/seams.py SEAM103); the per-bucket
+        # ledger can't carry these because a race has no bucket width
+        self._frontier = {"races": 0, "escalations": 0, "device_s": 0.0}
 
     def record_call(
         self,
@@ -162,6 +167,17 @@ class CostAccounting:
             self._farm["dispatches"] += dispatches
             self._farm["hedges"] += hedges
             self._farm["dup_solutions"] += dup_solutions
+
+    def note_frontier(
+        self, *, device_s: float = 0.0, escalated: bool = False
+    ) -> None:
+        """Fold one completed frontier race (engine._frontier_raw): its
+        dispatch→answer wall time, and whether it was an escalation from
+        a quick-probe miss rather than a direct frontier request."""
+        with self._lock:
+            self._frontier["races"] += 1
+            self._frontier["escalations"] += int(bool(escalated))
+            self._frontier["device_s"] += max(0.0, device_s)
 
     def note_formation(self, wait_s: float, fill: int) -> None:
         """One coalesced batch formed: the oldest rider's queue wait and
@@ -277,6 +293,7 @@ class CostAccounting:
             seg_totals = dict(self._seg_totals)
             segments = list(self._segments)
             farm = dict(self._farm)
+            frontier = dict(self._frontier)
         out = {
             "dispatches": dispatches,
             "boards": boards,
@@ -356,6 +373,14 @@ class CostAccounting:
             # node has actually farmed, so single-node /metrics bodies
             # stay byte-identical to the PR 13 surface
             out["farm"] = farm
+        if frontier["races"]:
+            # same presence contract as the farm block: nodes that never
+            # race keep their previous /metrics surface
+            out["frontier"] = {
+                "races": frontier["races"],
+                "escalations": frontier["escalations"],
+                "device_s": round(frontier["device_s"], 4),
+            }
         if formation:
             out["formation"] = {
                 "batches": len(formation),

@@ -104,6 +104,29 @@ class Histogram:
             "count": self.count,
         }
 
+    def quantile_ms(self, q: float) -> float:
+        """Bucket-interpolated quantile (the Prometheus histogram_quantile
+        estimate): O(buckets), no sample sort — the telemetry digest runs
+        this on the UDP gossip loop, where sorting a sample window is the
+        driver-stall class analysis/threadctx.py flags (THREAD104).
+        Resolution is bucket-width, which gossip-grade percentiles can
+        afford; the exact window percentiles stay on the pull-based
+        ``/metrics`` route."""
+        if self.count == 0:
+            return 0.0
+        rank = max(0.0, min(1.0, q)) * self.count
+        cum = 0
+        lower = 0.0
+        for i, upper in enumerate(self.bounds_ms):
+            prev = cum
+            cum += self.counts[i]
+            if cum >= rank and self.counts[i]:
+                frac = (rank - prev) / self.counts[i]
+                return round(lower + (upper - lower) * frac, 3)
+            lower = upper
+        # +Inf bucket has no upper edge: clamp to the largest finite bound
+        return round(self.bounds_ms[-1], 3)
+
 
 class RouteMetrics:
     """Per-route latency recorder — the ``/metrics`` route blocks.
@@ -162,6 +185,21 @@ class RouteMetrics:
                 entry.update(window.summary_ms())
                 out[route] = entry
             return out
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        """{route: {count, errors, shed}} — counters only, NO window
+        sort. The telemetry digest (obs/cluster.build_digest) needs just
+        these sums, and it runs on the UDP gossip loop: ``summary()``'s
+        per-route sort there is the THREAD104 hazard class."""
+        with self._lock:
+            return {
+                route: {
+                    "count": self._count[route],
+                    "errors": self._errors[route],
+                    "shed": self._shed[route],
+                }
+                for route in self._count
+            }
 
 
 class StageMetrics:
@@ -222,3 +260,17 @@ class StageMetrics:
         """{stage: Histogram.snapshot()} for the Prometheus renderer."""
         with self._lock:
             return {s: h.snapshot() for s, h in sorted(self._hist.items())}
+
+    def digest_quantiles(
+        self, stage: str, qs: Sequence[float] = (0.5, 0.99)
+    ) -> Tuple[float, ...]:
+        """Histogram-estimated quantiles (ms) for one stage — the
+        telemetry digest's read path. O(buckets) per quantile and no
+        window sort, so it is safe on the UDP gossip loop; an unseen
+        stage reads as all-zeros, matching ``summary()``'s absent-key
+        default in build_digest."""
+        with self._lock:
+            h = self._hist.get(stage)
+            if h is None:
+                return tuple(0.0 for _ in qs)
+            return tuple(h.quantile_ms(q) for q in qs)

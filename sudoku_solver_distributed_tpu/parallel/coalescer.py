@@ -623,7 +623,13 @@ class BatchCoalescer:
         while True:
             with self._cond:
                 while not self._pending and not self._shutdown:
-                    self._cond.wait()
+                    # bounded like every other wait in this loop: the
+                    # timeout guards a lost wakeup (a submit/shutdown
+                    # notify that raced this thread between the predicate
+                    # check and the park would otherwise stall the
+                    # dispatcher forever — it is the singleton driver for
+                    # its engine, so a stall here is an outage, not a bug)
+                    self._cond.wait(timeout=0.25)
                 if not self._pending:
                     return None  # shutdown, queue drained
                 # fixed budgets, or the adaptive policy's current values

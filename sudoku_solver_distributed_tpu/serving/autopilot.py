@@ -52,7 +52,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional
 
-from ..obs.histo import LatencyWindow
+from ..obs.histo import Histogram, LatencyWindow
 
 logger = logging.getLogger(__name__)
 
@@ -195,6 +195,12 @@ class Autopilot:
         # law 3 state/counters (RTT window under the autopilot lock —
         # the histo classes are owner-locked by contract)
         self._rtt = LatencyWindow(window=512)
+        # histogram twin of the window: the telemetry digest reads its
+        # p99 from HERE (O(buckets)) because build_digest runs on the
+        # UDP gossip loop, where sorting the window per wakeup is the
+        # THREAD104 driver-stall class; the hedge threshold keeps the
+        # exact window percentile (it runs on farm handler threads)
+        self._rtt_hist = Histogram()
         self._rtt_count = 0
         # cold-threshold gossip seeding (PR 15 — the PR 14 recorded
         # limit): times the hedge threshold was answered from a peer's
@@ -325,6 +331,7 @@ class Autopilot:
         sample stream the hedge threshold's p99 is read from."""
         with self._lock:
             self._rtt.add(max(0.0, seconds))
+            self._rtt_hist.add(max(0.0, seconds))
             self._rtt_count += 1
 
     def hedge_threshold_s(self) -> float:
@@ -379,7 +386,9 @@ class Autopilot:
         with self._lock:
             if self._rtt_count < MIN_RTT_SAMPLES:
                 return None
-            return round(self._rtt.summary_ms()["p99_ms"], 3)
+            # histogram estimate, not the window sort: this runs on the
+            # UDP gossip loop via build_digest (THREAD104)
+            return self._rtt_hist.quantile_ms(0.99)
 
     def try_hedge(self) -> bool:
         """Spend one unit of hedge budget, or refuse: lifetime hedges

@@ -15,6 +15,7 @@ The analyzers are pure stdlib-``ast`` — these tests never import jax
 and run in milliseconds.
 """
 
+import json
 import textwrap
 from pathlib import Path
 
@@ -25,24 +26,40 @@ from sudoku_solver_distributed_tpu.analysis import (
     apply_baseline,
     default_config,
     load_baseline,
+    run_analysis,
     run_analyzers,
 )
-from sudoku_solver_distributed_tpu.analysis.__main__ import main
+from sudoku_solver_distributed_tpu.analysis import seams, threadctx
+from sudoku_solver_distributed_tpu.analysis.__main__ import (
+    JSON_SCHEMA_VERSION,
+    _JSON_KEYS,
+    main,
+)
+from sudoku_solver_distributed_tpu.analysis._astutil import iter_modules
+from sudoku_solver_distributed_tpu.analysis.callgraph import build_graph
+from sudoku_solver_distributed_tpu.analysis.seams import (
+    MATRIX_SCHEMA_VERSION,
+    ShapeSpec,
+)
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
 
 
 # -- harness -----------------------------------------------------------------
 
-def run_fixture(
+def analyze_fixture(
     tmp_path,
     files,
     *,
     serving=(),
     consumers=(),
     analyzers=("locks", "jax", "wire"),
+    shapes=None,
 ):
-    """Write a fixture package and run the analyzers over it."""
+    """Write a fixture package and run the analyzers over it, returning
+    the full :class:`AnalysisResult` (findings + contract matrix + the
+    wire consumers actually analyzed). ``consumers=None`` exercises
+    call-graph auto-discovery, exactly like the repo default."""
     pkg = tmp_path / "pkg"
     for rel, src in files.items():
         p = pkg / rel
@@ -53,11 +70,39 @@ def run_fixture(
         package=pkg,
         serving=tuple(serving),
         wire_producer="net/wire.py",
-        wire_consumers=tuple(consumers),
+        wire_consumers=None if consumers is None else tuple(consumers),
         baseline=None,
         analyzers=tuple(analyzers),
+        shapes=shapes,
     )
-    return run_analyzers(cfg)
+    return run_analysis(cfg)
+
+
+def run_fixture(
+    tmp_path,
+    files,
+    *,
+    serving=(),
+    consumers=(),
+    analyzers=("locks", "jax", "wire"),
+    shapes=None,
+):
+    """Findings-only fixture harness (most tests want just these)."""
+    return analyze_fixture(
+        tmp_path,
+        files,
+        serving=serving,
+        consumers=consumers,
+        analyzers=analyzers,
+        shapes=shapes,
+    ).findings
+
+
+@pytest.fixture(scope="module")
+def repo_result():
+    """One full analysis of the real repo, shared by the matrix/budget/
+    discovery tests (the run itself is what the budget test times)."""
+    return run_analysis(default_config())
 
 
 def rules_of(findings):
@@ -1024,9 +1069,15 @@ def test_cli_strict_nonzero_on_each_rule_fixture(tmp_path, capsys):
             "    return np.asarray(_p(a))\n",
         },
         "wire": {
+            # consumers are auto-discovered from decode_msg call sites,
+            # so the fixture carries the real receive shape
             "net/wire.py": 'def a_msg(x):\n'
-            '    return {"type": "a", "x": x}\n',
-            "net/node.py": 'def handle(msg):\n'
+            '    return {"type": "a", "x": x}\n'
+            'def decode_msg(raw):\n'
+            '    return raw\n',
+            "net/node.py": 'def on_datagram(raw):\n'
+            '    return handle(decode_msg(raw))\n'
+            'def handle(msg):\n'
             '    if msg.get("type") == "a":\n'
             '        return msg["missing"]\n',
         },
@@ -1097,3 +1148,555 @@ def test_cli_json_output_shape(tmp_path, capsys):
         body
     )
     assert body["errors"] and body["errors"][0]["rule"] == "LOCK102"
+
+
+# -- dispatch-contract seams (SEAM1xx) ---------------------------------------
+
+MINI_ENGINE = """
+    class Engine:
+        def __init__(self, prog):
+            self._prog = prog
+
+        def dispatch(self, board):
+            return self._prog(board)
+"""
+
+
+def _mini_shape():
+    return ShapeSpec(
+        shape="mini",
+        entry=("api.py", "solve_route"),
+        sinks=(("engine.py", "Engine.dispatch"),),
+    )
+
+
+def test_seam_uncontracted_dispatch_flags_all_five_legs(tmp_path):
+    # a route that reaches the jit seam with NONE of the contract legs
+    # anywhere on the path: one finding per missing leg
+    findings = run_fixture(
+        tmp_path,
+        {
+            "api.py": """
+            def solve_route(node, body):
+                return node.engine.dispatch(parse(body))
+
+            def parse(body):
+                return body
+            """,
+            "engine.py": MINI_ENGINE,
+        },
+        analyzers=("seams",),
+        shapes=(_mini_shape(),),
+    )
+    assert rules_of(findings) == [
+        "SEAM101", "SEAM102", "SEAM103", "SEAM104", "SEAM105",
+    ]
+    assert all(f.symbol == "dispatch:mini" for f in findings)
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_seam_legs_across_handoff_and_extras_cover(tmp_path):
+    # the corrected twin, shaped like the real repo: supervision/
+    # deadline/fallback on the route core, trace on the driver loop
+    # BEHIND a declared thread handoff, cost on a declared completion-
+    # side extra — the union over the path covers all five legs
+    result = analyze_fixture(
+        tmp_path,
+        {
+            "api.py": """
+            def solve_route(node, body, deadline_s):
+                token = node.supervisor.call_started(9)
+                if deadline_s <= 0:
+                    raise DeadlineExceeded()
+                try:
+                    out = node.coalescer.submit(parse(body))
+                except Exception:
+                    node.supervisor.call_finished(token, ok=False)
+                    return node.supervisor.fallback_solve(body)
+                node.supervisor.call_finished(token, ok=True)
+                return out
+
+            def parse(body):
+                return body
+            """,
+            "coalescer.py": """
+            class Coalescer:
+                def submit(self, board):
+                    self._pending.append(board)
+
+                def _driver_loop(self, tr):
+                    while True:
+                        tr.mark("device")
+                        self.engine.dispatch(self._pending.pop())
+            """,
+            "engine.py": MINI_ENGINE + """
+        def finalize(self, out):
+            self.cost.record_call(1)
+            return out
+            """,
+        },
+        analyzers=("seams",),
+        shapes=(
+            ShapeSpec(
+                shape="mini",
+                entry=("api.py", "solve_route"),
+                sinks=(("engine.py", "Engine.dispatch"),),
+                handoffs=(
+                    (
+                        ("coalescer.py", "Coalescer.submit"),
+                        ("coalescer.py", "Coalescer._driver_loop"),
+                    ),
+                ),
+                extras=(("engine.py", "Engine.finalize"),),
+            ),
+        ),
+    )
+    assert result.findings == [], "\n".join(
+        f.format() for f in result.findings
+    )
+    (shape,) = result.contract_matrix["shapes"]
+    assert shape["covered"] == {
+        leg: True for leg in result.contract_matrix["legs"]
+    }
+    # the inventory names WHO provides each leg — the driver loop
+    # behind the handoff for trace, the completion extra for cost
+    assert any(
+        "Coalescer._driver_loop" in k for k in shape["provided_by"]["trace"]
+    )
+    assert any(
+        "Engine.finalize" in k for k in shape["provided_by"]["cost"]
+    )
+
+
+def test_seam_registry_rot_missing_symbol_and_dead_path(tmp_path):
+    # SEAM106 both ways: a declared sink that no longer exists, and a
+    # registry whose symbols all resolve but whose entry no longer
+    # reaches the sink — neither may go silently dead
+    files = {
+        "api.py": """
+        def solve_route(node, body):
+            return parse(body)
+
+        def parse(body):
+            return body
+        """,
+        "engine.py": MINI_ENGINE,
+    }
+    findings = run_fixture(
+        tmp_path,
+        files,
+        analyzers=("seams",),
+        shapes=(
+            ShapeSpec(
+                shape="ghost",
+                entry=("api.py", "solve_route"),
+                sinks=(("engine.py", "Engine.vanished"),),
+            ),
+        ),
+    )
+    assert rules_of(findings) == ["SEAM106"]
+    assert "not found" in findings[0].message
+    findings = run_fixture(
+        tmp_path, files, analyzers=("seams",), shapes=(_mini_shape(),)
+    )
+    assert rules_of(findings) == ["SEAM106"]
+    assert "no dispatch path" in findings[0].message
+
+
+# -- thread-context hazards (THREAD1xx) --------------------------------------
+
+THREAD_HEADER = "import threading\nimport time\n"
+
+
+def test_thread_loop_thread_hazards_all_flagged(tmp_path):
+    # a singleton driver loop (self-held handle, constant name) reaching
+    # expensive CPU work, an unbounded callee wait, a long park, and a
+    # full sort of a growable shared queue — one finding per hazard
+    findings = run_fixture(
+        tmp_path,
+        {
+            "mod.py": THREAD_HEADER + textwrap.dedent("""
+            def canonicalize(batch):
+                return batch
+
+            class Driver:
+                def __init__(self):
+                    self._pending = []
+                    self._t = threading.Thread(
+                        target=self._loop, name="driver"
+                    )
+
+                def _loop(self):
+                    while True:
+                        self._step()
+
+                def _step(self):
+                    batch = sorted(self._pending)
+                    canonicalize(batch)
+                    time.sleep(5)
+                    return self._q.get()
+
+                def add(self, x):
+                    self._pending.append(x)
+            """),
+        },
+        analyzers=("thread",),
+    )
+    assert rules_of(findings) == [
+        "THREAD101", "THREAD102", "THREAD103", "THREAD104",
+    ]
+    assert all(f.symbol == "Driver._step" for f in findings)
+    assert all("'driver'" in f.message for f in findings)
+
+
+def test_thread_bounded_loop_and_pool_idiom_clean(tmp_path):
+    # the corrected twin: the loop's OWN top-level wait is its
+    # scheduler (exempt), callee waits carry timeouts, sleeps are
+    # short, selection is bounded; plus a worker POOL (spawns inside a
+    # loop, dynamic names) whose blocking waits are its purpose
+    findings = run_fixture(
+        tmp_path,
+        {
+            "mod.py": THREAD_HEADER + textwrap.dedent("""
+            import heapq
+
+            class Driver:
+                def __init__(self):
+                    self._pending = []
+                    self._t = threading.Thread(
+                        target=self._loop, name="driver"
+                    )
+
+                def _loop(self):
+                    while True:
+                        self._q.get()
+                        self._step()
+
+                def _step(self):
+                    batch = heapq.nsmallest(8, self._pending)
+                    self._q.get(timeout=0.5)
+                    time.sleep(0.05)
+                    return batch
+
+                def add(self, x):
+                    self._pending.append(x)
+
+            class Pool:
+                def __init__(self):
+                    self._ts = []
+                    for i in range(4):
+                        t = threading.Thread(
+                            target=self._work, name=f"w-{i}"
+                        )
+                        self._ts.append(t)
+
+                def _work(self):
+                    while True:
+                        self._q.get()
+            """),
+        },
+        analyzers=("thread",),
+    )
+    assert findings == []
+
+
+def test_thread_registry_rot_flagged_with_explicit_registry(tmp_path):
+    # THREAD105: an exemption or extra-root entry matching nothing in
+    # the analyzed tree is rot, not a silent no-op
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        THREAD_HEADER
+        + textwrap.dedent("""
+        class Driver:
+            def __init__(self):
+                self._t = threading.Thread(
+                    target=self._loop, name="driver"
+                )
+
+            def _loop(self):
+                while True:
+                    self._tick()
+
+            def _tick(self):
+                return None
+        """)
+    )
+    graph = build_graph(list(iter_modules(pkg, tmp_path)))
+    findings = threadctx.analyze(
+        graph,
+        extra_roots=(("gone.py", "Ghost.run", "ghost-loop"),),
+        exempt=(("name", "ghost-thread"),),
+    )
+    assert rules_of(findings) == ["THREAD105"]
+    msg = findings[0].message
+    assert "name:ghost-thread" in msg
+    assert "gone.py::Ghost.run" in msg
+
+
+# -- cross-class lock order (LOCK106) ----------------------------------------
+
+def test_cross_class_abba_cycle_flagged(tmp_path):
+    # invisible per-class: Alpha holds its lock while entering Beta
+    # (which takes Beta's), Beta holds its lock while calling back into
+    # Alpha (which takes Alpha's) — the coalescer↔engine ABBA shape
+    findings = run_fixture(
+        tmp_path,
+        {
+            "mod.py": lock_mod("""
+            class Alpha:
+                def __init__(self, beta):
+                    self._a_lock = threading.Lock()
+                    self.beta = beta
+
+                def forward(self):
+                    with self._a_lock:
+                        self.beta.absorb()
+
+                def reenter(self):
+                    with self._a_lock:
+                        pass
+
+            class Beta:
+                def __init__(self, alpha):
+                    self._b_lock = threading.Lock()
+                    self.alpha = alpha
+
+                def absorb(self):
+                    with self._b_lock:
+                        pass
+
+                def backward(self):
+                    with self._b_lock:
+                        self.alpha.reenter()
+            """),
+        },
+        analyzers=("locks",),
+    )
+    assert rules_of(findings) == ["LOCK106"]
+    (f,) = findings
+    assert "Alpha._a_lock" in f.message and "Beta._b_lock" in f.message
+
+
+def test_cross_class_consistent_order_clean(tmp_path):
+    # same two classes, one global order (Alpha outer): Beta calls back
+    # into Alpha only OUTSIDE its lock — no cycle
+    findings = run_fixture(
+        tmp_path,
+        {
+            "mod.py": lock_mod("""
+            class Alpha:
+                def __init__(self, beta):
+                    self._a_lock = threading.Lock()
+                    self.beta = beta
+
+                def forward(self):
+                    with self._a_lock:
+                        self.beta.absorb()
+
+                def reenter(self):
+                    with self._a_lock:
+                        pass
+
+            class Beta:
+                def __init__(self, alpha):
+                    self._b_lock = threading.Lock()
+                    self.alpha = alpha
+
+                def absorb(self):
+                    with self._b_lock:
+                        pass
+
+                def backward(self):
+                    self.alpha.reenter()
+                    with self._b_lock:
+                        pass
+            """),
+        },
+        analyzers=("locks",),
+    )
+    assert findings == []
+
+
+# -- wire-consumer auto-discovery --------------------------------------------
+
+def test_wire_consumers_auto_discovered_and_new_module_analyzed(tmp_path):
+    # the hand-maintained consumer tuple went stale in PR 13; with
+    # consumers=None the runner walks forward from decode_msg call
+    # sites instead. A brand-new handler module (stats.py here) must be
+    # picked up AND actually analyzed — its schema drift is a finding,
+    # not silence
+    result = analyze_fixture(
+        tmp_path,
+        {
+            "net/wire.py": WIRE_PRODUCER + """
+    def decode_msg(raw):
+        return raw
+            """,
+            "net/node.py": """
+            class Node:
+                def on_datagram(self, raw):
+                    msg = decode_msg(raw)
+                    self.handle(msg)
+                    self.stats.ingest(msg)
+
+                def handle(self, msg):
+                    t = msg.get("type")
+                    if t == "a":
+                        return msg["x"]
+                    return None
+            """,
+            "net/stats.py": """
+            class Stats:
+                def ingest(self, msg):
+                    t = msg.get("type")
+                    if t == "b":
+                        return msg["y"], msg["nope"]
+                    return None
+            """,
+        },
+        consumers=None,
+        analyzers=("wire",),
+    )
+    assert result.wire_consumers == ("net/node.py", "net/stats.py")
+    w101 = [f for f in result.findings if f.rule == "WIRE101"]
+    assert any(f.path.endswith("net/stats.py") for f in w101)
+    assert any("nope" in f.message for f in w101)
+
+
+def test_repo_wire_consumers_auto_discovery_matches_known_set(repo_result):
+    # the discovered set must cover every module the old hand list
+    # named (including the PR 13 addition that went stale back then)
+    assert set(repo_result.wire_consumers) == {
+        "cache/gossip.py",
+        "net/node.py",
+        "net/stats.py",
+        "utils/faults.py",
+    }
+
+
+# -- the five-shape contract matrix on the real repo -------------------------
+
+def test_repo_contract_matrix_all_shapes_all_legs_green(repo_result):
+    m = repo_result.contract_matrix
+    assert m["schema_version"] == MATRIX_SCHEMA_VERSION
+    assert m["legs"] == [
+        "supervision", "trace", "cost", "deadline", "fallback",
+    ]
+    shapes = {s["shape"]: s for s in m["shapes"]}
+    assert sorted(shapes) == [
+        "batch", "farm", "frontier", "segments", "single",
+    ]
+    for name, s in shapes.items():
+        assert s["paths"] >= 1, f"shape {name} has no dispatch path"
+        assert s["witness"], f"shape {name} has no witness path"
+        missing = [leg for leg, ok in s["covered"].items() if not ok]
+        assert not missing, f"shape {name} missing legs: {missing}"
+        for leg in m["legs"]:
+            assert s["provided_by"][leg], (name, leg)
+    # the inventory points at the real providers: the frontier shape's
+    # supervision/cost ride the _frontier_raw wrapper
+    frontier = shapes["frontier"]
+    for leg in ("supervision", "cost"):
+        assert any(
+            k.endswith("SolverEngine._frontier_raw")
+            for k in frontier["provided_by"][leg]
+        ), frontier["provided_by"][leg]
+
+
+def test_full_gate_stays_inside_two_second_budget(repo_result):
+    # the whole point of the shared parse + call graph: the gate stays
+    # cheap enough to run on every commit. One retry absorbs a noisy
+    # first run on a loaded box.
+    result = repo_result
+    if result.wall_s >= 2.0:
+        result = run_analysis(default_config())
+    assert result.wall_s < 2.0, f"graftcheck took {result.wall_s:.2f}s"
+
+
+# -- machine-readable output contracts ---------------------------------------
+
+def test_cli_json_schema_pinned(capsys):
+    # the --json payload is a consumed interface (the planned
+    # ExecutionPlane tooling reads contract_matrix): keys and versions
+    # are pinned, additions bump JSON_SCHEMA_VERSION
+    assert main(["--json"]) == 0
+    body = json.loads(capsys.readouterr().out)
+    assert JSON_SCHEMA_VERSION == 2
+    assert body["schema_version"] == JSON_SCHEMA_VERSION
+    assert set(body) == set(_JSON_KEYS)
+    assert body["errors"] == [] and body["stale_baseline"] == []
+    for f in body["suppressed"]:
+        assert set(f) == {
+            "rule", "severity", "path", "line", "symbol", "message",
+        }
+    m = body["contract_matrix"]
+    assert m["schema_version"] == MATRIX_SCHEMA_VERSION == 1
+    assert [s["shape"] for s in m["shapes"]] == [
+        "single", "batch", "frontier", "farm", "segments",
+    ]
+    for s in m["shapes"]:
+        assert set(s) == {
+            "shape", "entry", "sinks", "paths", "witness",
+            "covered", "provided_by",
+        }
+        assert s["witness"][0] == s["entry"]
+        assert all(s["covered"].values()), s
+    assert body["wire_consumers"] == [
+        "cache/gossip.py", "net/node.py", "net/stats.py",
+        "utils/faults.py",
+    ]
+
+
+def test_cli_sarif_fixture_emission(tmp_path, capsys):
+    pkg = _write_pkg(
+        tmp_path,
+        {
+            "mod.py": textwrap.dedent(LOCK_HEADER)
+            + "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue(maxsize=1)\n"
+            "    def bad(self):\n"
+            "        with self._lock:\n"
+            "            self._q.put(1)\n",
+        },
+    )
+    out = tmp_path / "graftcheck.sarif"
+    assert main(["--package", str(pkg), "--sarif", str(out)]) == 0
+    capsys.readouterr()
+    body = json.loads(out.read_text())
+    assert body["version"] == "2.1.0"
+    run = body["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftcheck"
+    (res,) = run["results"]
+    assert res["ruleId"] == "LOCK102" and res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+    assert loc["region"]["startLine"] >= 1
+    assert res["partialFingerprints"][
+        "graftcheckFindingKey/v1"
+    ].startswith("LOCK102:")
+    assert "suppressions" not in res
+    assert res["ruleId"] in [
+        r["id"] for r in run["tool"]["driver"]["rules"]
+    ]
+
+
+def test_cli_sarif_repo_baselined_debt_stays_visible(tmp_path, capsys):
+    # the repo is strict-clean, so every error-severity SARIF result is
+    # baselined debt — emitted WITH a suppression record, not dropped
+    out = tmp_path / "repo.sarif"
+    assert main(["--strict", "--sarif", str(out)]) == 0
+    capsys.readouterr()
+    body = json.loads(out.read_text())
+    results = body["runs"][0]["results"]
+    suppressed = [r for r in results if "suppressions" in r]
+    assert suppressed, "baselined debt must stay visible in SARIF"
+    for r in suppressed:
+        assert r["suppressions"][0]["kind"] == "external"
+    assert not any(
+        r["level"] == "error"
+        for r in results
+        if "suppressions" not in r
+    ), "unsuppressed error leaked into a strict-clean run"

@@ -476,7 +476,12 @@ def test_hostile_hotset_and_cache_answer_rejected(engine):
         # waiter the real try_peer_fetch would have, so the write gate
         # (not the solicitation gate) is what each delivery exercises
         with node.cache_gossip._waiters_lock:
-            node.cache_gossip._waiters[k] = (threading.Event(), 1)
+            node.cache_gossip._register_waiter(k)
+
+    def drain_waiter(k):
+        # the UDP loop only parks the payload; the fetcher thread runs
+        # the write gate — releasing the registration drains it here
+        node.cache_gossip._release_waiter(k)
 
     board = generate_batch(1, 30, size=9, seed=1315, unique=True)[0]
     sol = oracle_solve(board.tolist())
@@ -494,6 +499,7 @@ def test_hostile_hotset_and_cache_answer_rejected(engine):
         ),
         source=("127.0.0.1", 7001),
     )
+    drain_waiter(key)
     assert len(node.answer_cache) == 0
     assert node.answer_cache.peer_rejects == 1
     # a Latin-square payload with a non-perfect-square edge passes the
@@ -509,6 +515,7 @@ def test_hostile_hotset_and_cache_answer_rejected(engine):
         ),
         source=("127.0.0.1", 7001),
     )
+    drain_waiter("b" * 64)
     assert len(node.answer_cache) == 0
     assert node.answer_cache.peer_rejects == 2
     # out-of-range cells must be counted-and-dropped, not raise out of
@@ -525,6 +532,7 @@ def test_hostile_hotset_and_cache_answer_rejected(engine):
             ),
             source=("127.0.0.1", 7001),
         )
+        drain_waiter("c" * 64)
     assert len(node.answer_cache) == 0
     assert node.answer_cache.peer_rejects == 4
     # UNSOLICITED answers — even valid ones — drop before verification:
@@ -544,6 +552,7 @@ def test_hostile_hotset_and_cache_answer_rejected(engine):
         wire.cache_answer_msg(key, board.tolist(), sol, "127.0.0.1:7001"),
         source=("127.0.0.1", 7001),
     )
+    drain_waiter(key)
     assert node.answer_cache.contains(key)
     # reflection guard: a cache_get whose claimed address does not
     # match its UDP source gets NO reply — the multi-KB positive

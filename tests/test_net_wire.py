@@ -316,13 +316,11 @@ def test_cache_messages_clear_handler_ingress():
     log.addHandler(handler)
     try:
         # cache_answer → verified fold into the store (solicited-only:
-        # register the fetch waiter the real try_peer_fetch would hold)
-        import threading as _threading
-
+        # register the fetch waiter the real try_peer_fetch would hold;
+        # releasing it drains the parked payload through the write gate
+        # on this thread, as the fetcher would)
         with node.cache_gossip._waiters_lock:
-            node.cache_gossip._waiters["e" * 64] = (
-                _threading.Event(), 1,
-            )
+            node.cache_gossip._register_waiter("e" * 64)
         msg = wire.decode_msg(
             wire.encode_msg(
                 wire.cache_answer_msg(
@@ -331,6 +329,7 @@ def test_cache_messages_clear_handler_ingress():
             )
         )
         node.handle_message(msg, source=PEER_SRC)
+        node.cache_gossip._release_waiter("e" * 64)
         assert len(node.answer_cache) == 1
         from sudoku_solver_distributed_tpu.cache.canonical import (
             canonicalize,
